@@ -1,0 +1,25 @@
+"""charon_tpu — a TPU-native distributed-validator framework.
+
+A ground-up reimplementation of the capabilities of the reference Go
+implementation (Obol Charon, surveyed in SURVEY.md): n nodes jointly operate
+Ethereum validators whose BLS12-381 keys are split t-of-n, coordinating duties
+via QBFT consensus and a slot-scheduled pipeline, with threshold-BLS
+signature verification and aggregation executed **batch-first on TPU** via
+JAX (pjit/shard_map over a device mesh) instead of one-at-a-time CPU calls.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+  cmd/      CLI entry points                 (ref: cmd/)
+  app/      wiring, lifecycle, infra         (ref: app/)
+  core/     duty workflow components         (ref: core/)
+  dkg/      FROST distributed key generation (ref: dkg/)
+  cluster/  cluster definition/lock formats  (ref: cluster/)
+  p2p/      peer networking                  (ref: p2p/)
+  tbls/     threshold-BLS facade w/ swappable backends (ref: tbls/)
+  crypto/   pure-Python BLS12-381 reference implementation
+  ops/      JAX/Pallas batched crypto kernels (the TPU hot path)
+  parallel/ device-mesh sharding of the crypto batch plane
+  eth2util/ eth2 signing domains, keystores, helpers (ref: eth2util/)
+  testutil/ beaconmock, validatormock, simnet substrate (ref: testutil/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""BLS12-381 reference implementation (pure Python).
+
+This package is the framework's ground-truth for threshold-BLS: a complete,
+dependency-free BLS12-381 stack — field towers, curve groups, optimal-ate
+pairing, RFC-9380 hash-to-curve, eth2 (ZCash) point serialization, RFC-style
+key generation, and Shamir/Lagrange threshold operations.
+
+It plays the role herumi/bls-eth-go-binary plays in the reference
+(ref: tbls/herumi.go, go.mod:14) — but as the *correctness oracle*: the JAX
+TPU backend (charon_tpu/ops) and the C++ host backend are validated
+byte-for-byte against this module, mirroring the reference's randomized
+cross-implementation test strategy (ref: tbls/tbls_test.go:209-237).
+
+Not constant-time: secret-key operations here are for reference/testing.
+"""
+
+from charon_tpu.crypto import bls, fields, g1g2, pairing, shamir  # noqa: F401

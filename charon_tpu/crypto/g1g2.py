@@ -1,0 +1,252 @@
+"""BLS12-381 curve groups G1 (over Fp) and G2 (over Fp2).
+
+E1:  y^2 = x^3 + 4        over Fp
+E2:  y^2 = x^3 + 4(1+u)   over Fp2   (M-twist of E1)
+
+Points are affine tuples (x, y) with None representing the identity. Affine
+arithmetic with Python bigints is fast enough for the reference role; the
+batched JAX engine uses Jacobian coordinates (charon_tpu/ops).
+
+Serialization follows the ZCash/eth2 compressed format (48-byte G1, 96-byte
+G2, flag bits in the 3 MSBs), matching the reference's wire types
+(ref: tbls/tbls.go:16-25 — PublicKey [48]byte, Signature [96]byte).
+"""
+
+from __future__ import annotations
+
+from charon_tpu.crypto.fields import (
+    FP2_ONE,
+    FP2_ZERO,
+    P,
+    R,
+    fp2_add,
+    fp2_inv,
+    fp2_is_lex_largest,
+    fp2_is_zero,
+    fp2_mul,
+    fp2_neg,
+    fp2_scalar,
+    fp2_sqr,
+    fp2_sqrt,
+    fp2_sub,
+    fp_inv,
+    fp_sqrt,
+)
+
+B1 = 4
+B2 = (4, 4)  # 4 * (1 + u)
+
+# Standard generators (from the BLS12-381 specification).
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# G1 (affine over Fp)
+# ---------------------------------------------------------------------------
+
+
+def g1_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - x * x * x - B1) % P == 0
+
+
+def g1_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], (-pt[1]) % P)
+
+
+def g1_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        m = 3 * x1 * x1 * fp_inv(2 * y1) % P
+    else:
+        m = (y2 - y1) * fp_inv(x2 - x1) % P
+    x3 = (m * m - x1 - x2) % P
+    y3 = (m * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def g1_double(pt):
+    return g1_add(pt, pt)
+
+
+def g1_mul_raw(pt, k: int):
+    """Scalar mul WITHOUT reducing k mod r (for cofactor clearing)."""
+    out = None
+    add = pt
+    while k:
+        if k & 1:
+            out = g1_add(out, add)
+        add = g1_add(add, add)
+        k >>= 1
+    return out
+
+
+def g1_mul(pt, k: int):
+    return g1_mul_raw(pt, k % R)
+
+
+def g1_in_subgroup(pt) -> bool:
+    return g1_is_on_curve(pt) and g1_mul_raw(pt, R) is None
+
+
+# ---------------------------------------------------------------------------
+# G2 (affine over Fp2)
+# ---------------------------------------------------------------------------
+
+
+def g2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    rhs = fp2_add(fp2_mul(fp2_sqr(x), x), B2)
+    return fp2_sub(fp2_sqr(y), rhs) == FP2_ZERO
+
+
+def g2_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], fp2_neg(pt[1]))
+
+
+def g2_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if fp2_is_zero(fp2_add(y1, y2)):
+            return None
+        m = fp2_mul(fp2_scalar(fp2_sqr(x1), 3), fp2_inv(fp2_scalar(y1, 2)))
+    else:
+        m = fp2_mul(fp2_sub(y2, y1), fp2_inv(fp2_sub(x2, x1)))
+    x3 = fp2_sub(fp2_sub(fp2_sqr(m), x1), x2)
+    y3 = fp2_sub(fp2_mul(m, fp2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_double(pt):
+    return g2_add(pt, pt)
+
+
+def g2_mul_raw(pt, k: int):
+    out = None
+    add = pt
+    while k:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_add(add, add)
+        k >>= 1
+    return out
+
+
+def g2_mul(pt, k: int):
+    return g2_mul_raw(pt, k % R)
+
+
+def g2_in_subgroup(pt) -> bool:
+    return g2_is_on_curve(pt) and g2_mul_raw(pt, R) is None
+
+
+# ---------------------------------------------------------------------------
+# ZCash-format compressed serialization (the eth2 wire format)
+# ---------------------------------------------------------------------------
+
+_COMPRESSED = 0x80
+_INFINITY = 0x40
+_LEX_LARGEST = 0x20
+
+
+def g1_to_bytes(pt) -> bytes:
+    if pt is None:
+        return bytes([_COMPRESSED | _INFINITY]) + bytes(47)
+    x, y = pt
+    flags = _COMPRESSED | (_LEX_LARGEST if y > (P - 1) // 2 else 0)
+    out = bytearray(x.to_bytes(48, "big"))
+    out[0] |= flags
+    return bytes(out)
+
+
+def g1_from_bytes(data: bytes, subgroup_check: bool = True):
+    if len(data) != 48:
+        raise ValueError("G1 compressed point must be 48 bytes")
+    flags = data[0]
+    if not flags & _COMPRESSED:
+        raise ValueError("uncompressed G1 not supported")
+    if flags & _INFINITY:
+        if any(data[1:]) or flags & _LEX_LARGEST or data[0] & 0x3F:
+            raise ValueError("malformed infinity encoding")
+        return None
+    x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("G1 x out of range")
+    y = fp_sqrt((x * x * x + B1) % P)
+    if y is None:
+        raise ValueError("G1 x not on curve")
+    if (y > (P - 1) // 2) != bool(flags & _LEX_LARGEST):
+        y = P - y
+    pt = (x, y)
+    if subgroup_check and not g1_in_subgroup(pt):
+        raise ValueError("G1 point not in subgroup")
+    return pt
+
+
+def g2_to_bytes(pt) -> bytes:
+    if pt is None:
+        return bytes([_COMPRESSED | _INFINITY]) + bytes(95)
+    (x0, x1), y = pt
+    flags = _COMPRESSED | (_LEX_LARGEST if fp2_is_lex_largest(y) else 0)
+    out = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+    out[0] |= flags
+    return bytes(out)
+
+
+def g2_from_bytes(data: bytes, subgroup_check: bool = True):
+    if len(data) != 96:
+        raise ValueError("G2 compressed point must be 96 bytes")
+    flags = data[0]
+    if not flags & _COMPRESSED:
+        raise ValueError("uncompressed G2 not supported")
+    if flags & _INFINITY:
+        if any(data[1:]) or flags & _LEX_LARGEST or data[0] & 0x3F:
+            raise ValueError("malformed infinity encoding")
+        return None
+    x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 x out of range")
+    x = (x0, x1)
+    y = fp2_sqrt(fp2_add(fp2_mul(fp2_sqr(x), x), B2))
+    if y is None:
+        raise ValueError("G2 x not on curve")
+    if fp2_is_lex_largest(y) != bool(flags & _LEX_LARGEST):
+        y = fp2_neg(y)
+    pt = (x, y)
+    if subgroup_check and not g2_in_subgroup(pt):
+        raise ValueError("G2 point not in subgroup")
+    return pt

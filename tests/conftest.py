@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The driver benches on one real TPU chip, but multi-chip sharding must be
+validated somewhere: we follow the reference's simnet-in-one-process strategy
+(ref: testutil/integration/simnet_test.go) by running all sharding tests on a
+virtual 8-device CPU mesh (xla_force_host_platform_device_count).
+
+This must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

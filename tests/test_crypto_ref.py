@@ -1,0 +1,235 @@
+"""Correctness tests for the pure-Python BLS12-381 reference implementation.
+
+Validation strategy (no network, no external vectors): algebraic properties —
+generator/subgroup membership, pairing bilinearity, sign/verify roundtrips,
+serialization roundtrips, Shamir threshold identities. Mirrors the
+reference's crypto test approach (ref: tbls/tbls_test.go).
+"""
+
+import pytest
+
+from charon_tpu.crypto import bls, h2c, shamir
+from charon_tpu.crypto.fields import (
+    FP12_ONE,
+    P,
+    R,
+    fp2_inv,
+    fp2_mul,
+    fp2_sqrt,
+    fp2_sqr,
+    fp6_inv,
+    fp6_mul,
+    fp12_frobenius_n,
+    fp12_inv,
+    fp12_mul,
+    fp12_pow,
+    FP6_ONE,
+)
+from charon_tpu.crypto.g1g2 import (
+    G1_GEN,
+    G2_GEN,
+    g1_add,
+    g1_from_bytes,
+    g1_in_subgroup,
+    g1_is_on_curve,
+    g1_mul,
+    g1_to_bytes,
+    g2_add,
+    g2_from_bytes,
+    g2_in_subgroup,
+    g2_is_on_curve,
+    g2_mul,
+    g2_to_bytes,
+)
+from charon_tpu.crypto.pairing import pairing
+
+
+class TestFields:
+    def test_fp2_inv(self):
+        a = (12345, 67890)
+        assert fp2_mul(a, fp2_inv(a)) == (1, 0)
+
+    def test_fp2_sqrt_roundtrip(self):
+        a = (987654321, 123456789)
+        sq = fp2_sqr(a)
+        root = fp2_sqrt(sq)
+        assert root is not None
+        assert fp2_sqr(root) == sq
+
+    def test_fp6_inv(self):
+        a = ((1, 2), (3, 4), (5, 6))
+        assert fp6_mul(a, fp6_inv(a)) == FP6_ONE
+
+    def test_fp12_inv_and_pow(self):
+        a = (((1, 2), (3, 4), (5, 6)), ((7, 8), (9, 10), (11, 12)))
+        prod = fp12_mul(a, fp12_inv(a))
+        assert prod == FP12_ONE
+        # Lagrange: x^(p^12 - 1) == 1 for any nonzero x — check via frobenius
+        # consistency instead of a 4500-bit pow: frob^12 == identity.
+        assert fp12_frobenius_n(a, 12) == tuple(
+            tuple(tuple(c % P for c in co) for co in six) for six in a
+        )
+
+    def test_frobenius_matches_pow(self):
+        a = (((3, 1), (0, 2), (4, 9)), ((2, 6), (5, 3), (5, 8)))
+        assert fp12_frobenius_n(a, 1) == fp12_pow(a, P)
+
+
+class TestCurves:
+    def test_generators_on_curve_and_in_subgroup(self):
+        assert g1_is_on_curve(G1_GEN)
+        assert g2_is_on_curve(G2_GEN)
+        assert g1_in_subgroup(G1_GEN)
+        assert g2_in_subgroup(G2_GEN)
+
+    def test_group_laws_g1(self):
+        a = g1_mul(G1_GEN, 123)
+        b = g1_mul(G1_GEN, 456)
+        assert g1_add(a, b) == g1_mul(G1_GEN, 579)
+        assert g1_mul(G1_GEN, R) is None
+
+    def test_group_laws_g2(self):
+        a = g2_mul(G2_GEN, 111)
+        b = g2_mul(G2_GEN, 222)
+        assert g2_add(a, b) == g2_mul(G2_GEN, 333)
+        assert g2_mul(G2_GEN, R) is None
+
+    def test_serialization_roundtrip_g1(self):
+        for k in (1, 2, 0xDEADBEEF, R - 1):
+            pt = g1_mul(G1_GEN, k)
+            assert g1_from_bytes(g1_to_bytes(pt)) == pt
+        assert g1_from_bytes(g1_to_bytes(None)) is None
+
+    def test_serialization_roundtrip_g2(self):
+        for k in (1, 3, 0xCAFEBABE, R - 2):
+            pt = g2_mul(G2_GEN, k)
+            assert g2_from_bytes(g2_to_bytes(pt)) == pt
+        assert g2_from_bytes(g2_to_bytes(None)) is None
+
+    def test_g1_generator_bytes_known_prefix(self):
+        # The compressed G1 generator is a well-known 48-byte constant.
+        assert g1_to_bytes(G1_GEN).hex().startswith("97f1d3a73197d794")
+
+    def test_deserialize_rejects_non_subgroup(self):
+        # x=0 gives y^2=4 -> y=2, a valid curve point that is NOT in the
+        # r-subgroup (cofactor > 1 would be needed); craft bytes directly.
+        data = bytearray((0).to_bytes(48, "big"))
+        data[0] |= 0x80
+        with pytest.raises(ValueError):
+            g1_from_bytes(bytes(data))
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        a, b = 5, 7
+        e_ab = pairing(g2_mul(G2_GEN, b), g1_mul(G1_GEN, a))
+        e_base = pairing(G2_GEN, G1_GEN)
+        assert e_ab == fp12_pow(e_base, a * b)
+        assert e_base != FP12_ONE
+
+    def test_pairing_nondegenerate_and_swapped_scalars(self):
+        e1 = pairing(g2_mul(G2_GEN, 6), g1_mul(G1_GEN, 11))
+        e2 = pairing(g2_mul(G2_GEN, 11), g1_mul(G1_GEN, 6))
+        assert e1 == e2  # e(aP, bQ) == e(bP, aQ) == e(P,Q)^ab
+
+    def test_gt_order(self):
+        e = pairing(G2_GEN, G1_GEN)
+        assert fp12_pow(e, R) == FP12_ONE
+
+
+class TestHashToCurve:
+    def test_maps_to_subgroup(self):
+        for msg in (b"", b"abc", b"charon-tpu", bytes(range(64))):
+            pt = h2c.hash_to_g2(msg)
+            assert pt is not None
+            assert g2_is_on_curve(pt)
+            assert g2_in_subgroup(pt)
+
+    def test_deterministic_and_msg_sensitive(self):
+        assert h2c.hash_to_g2(b"x") == h2c.hash_to_g2(b"x")
+        assert h2c.hash_to_g2(b"x") != h2c.hash_to_g2(b"y")
+
+    def test_dst_sensitive(self):
+        assert h2c.hash_to_g2(b"m", b"DST_A" + bytes(1)) != h2c.hash_to_g2(
+            b"m", b"DST_B" + bytes(1)
+        )
+
+    def test_expand_message_xmd_length(self):
+        out = expand = h2c.expand_message_xmd(b"msg", b"DST", 256)
+        assert len(out) == 256
+        assert expand[:32] != expand[32:64]
+
+
+class TestBLS:
+    def test_sign_verify(self):
+        sk = bls.keygen(b"\x13" * 32)
+        pk = bls.sk_to_pk(sk)
+        msg = b"attestation data root"
+        sig = bls.sign(sk, msg)
+        assert bls.verify(pk, msg, sig)
+        assert not bls.verify(pk, b"other message", sig)
+        sk2 = bls.keygen(b"\x14" * 32)
+        assert not bls.verify(bls.sk_to_pk(sk2), msg, sig)
+
+    def test_fast_aggregate_verify(self):
+        msg = b"same message for all"
+        sks = [bls.keygen(bytes([i]) * 32) for i in range(1, 5)]
+        pks = [bls.sk_to_pk(sk) for sk in sks]
+        agg = bls.aggregate_sigs([bls.sign(sk, msg) for sk in sks])
+        assert bls.fast_aggregate_verify(pks, msg, agg)
+        assert not bls.fast_aggregate_verify(pks[:-1], msg, agg)
+
+    def test_aggregate_verify_distinct_messages(self):
+        sks = [bls.keygen(bytes([40 + i]) * 32) for i in range(3)]
+        pks = [bls.sk_to_pk(sk) for sk in sks]
+        msgs = [b"m0", b"m1", b"m2"]
+        agg = bls.aggregate_sigs([bls.sign(sk, m) for sk, m in zip(sks, msgs)])
+        assert bls.aggregate_verify(pks, msgs, agg)
+        assert not bls.aggregate_verify(pks, [b"m0", b"m1", b"mX"], agg)
+
+    def test_keygen_deterministic(self):
+        assert bls.keygen(b"\x55" * 32) == bls.keygen(b"\x55" * 32)
+        assert bls.keygen(b"\x55" * 32) != bls.keygen(b"\x56" * 32)
+        with pytest.raises(ValueError):
+            bls.keygen(b"short")
+
+    def test_sk_serialization(self):
+        sk = bls.keygen(b"\x77" * 32)
+        assert bls.sk_from_bytes(bls.sk_to_bytes(sk)) == sk
+
+
+class TestThreshold:
+    def test_split_recover(self):
+        secret = bls.keygen(b"\x21" * 32)
+        shares = shamir.split(secret, 7, 4)
+        assert len(shares) == 7
+        # any 4 shares recover; fewer don't (w.h.p.)
+        subset = {i: shares[i] for i in (2, 3, 5, 7)}
+        assert shamir.recover_secret(subset) == secret
+        bad = {i: shares[i] for i in (2, 3, 5)}
+        assert shamir.recover_secret(bad) != secret
+
+    def test_threshold_signature_matches_group_signature(self):
+        """The core t-of-n identity: recombined partials == direct group sig
+        (ref: tbls/tbls_test.go threshold roundtrip)."""
+        secret = bls.keygen(b"\x42" * 32)
+        group_pk = bls.sk_to_pk(secret)
+        msg = b"duty: attester slot 12345"
+        shares = shamir.split(secret, 4, 3)
+        partials = {i: bls.sign(shares[i], msg) for i in (1, 2, 4)}
+        group_sig = shamir.threshold_aggregate_g2(partials)
+        assert group_sig == bls.sign(secret, msg)
+        assert bls.verify(group_pk, msg, group_sig)
+
+    def test_pubshare_recovery(self):
+        secret = bls.keygen(b"\x43" * 32)
+        shares = shamir.split(secret, 5, 3)
+        pubshares = {i: bls.sk_to_pk(s) for i, s in shares.items()}
+        sub = {i: pubshares[i] for i in (1, 3, 5)}
+        assert shamir.threshold_aggregate_g1(sub) == bls.sk_to_pk(secret)
+
+    def test_invalid_params(self):
+        with pytest.raises(ValueError):
+            shamir.split(123, 3, 1)
+        with pytest.raises(ValueError):
+            shamir.split(123, 3, 4)
