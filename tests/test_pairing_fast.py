@@ -1,0 +1,76 @@
+"""pairing_fast (production algorithm) vs the slow affine oracle.
+
+Mirrors the reference's cross-implementation strategy
+(ref: tbls/tbls_test.go:209-237): two independent implementations must agree.
+"""
+
+import random
+
+from charon_tpu.crypto import bls
+from charon_tpu.crypto.fields import FP12_ONE, fp12_mul, fp12_pow
+from charon_tpu.crypto.g1g2 import G1_GEN, G2_GEN, g1_mul, g1_neg, g2_mul
+from charon_tpu.crypto.h2c import hash_to_g2
+from charon_tpu.crypto.pairing import multi_miller
+from charon_tpu.crypto.pairing_fast import (
+    is_gt_one,
+    miller_loop_projective,
+    multi_pairing_fast,
+)
+
+rng = random.Random(0xC0FFEE)
+
+
+def rand_pairs(n):
+    pairs = []
+    for _ in range(n):
+        a = rng.randrange(1, 2**64)
+        b = rng.randrange(1, 2**64)
+        pairs.append((g2_mul(G2_GEN, a), g1_mul(G1_GEN, b)))
+    return pairs
+
+
+def test_single_pairing_matches_oracle_cubed():
+    pairs = rand_pairs(1)
+    fast = multi_pairing_fast(pairs)
+    oracle = multi_miller(pairs)
+    assert fast == fp12_pow(oracle, 3)
+
+
+def test_multi_pairing_matches_oracle_cubed():
+    pairs = rand_pairs(3)
+    fast = multi_pairing_fast(pairs)
+    oracle = multi_miller(pairs)
+    assert fast == fp12_pow(oracle, 3)
+
+
+def test_bilinearity_product_is_one():
+    # e(-aG1, bG2) * e(bG1, aG2) == 1
+    a = rng.randrange(1, 2**128)
+    b = rng.randrange(1, 2**128)
+    pairs = [
+        (g2_mul(G2_GEN, b), g1_neg(g1_mul(G1_GEN, a))),
+        (g2_mul(G2_GEN, a), g1_mul(G1_GEN, b)),
+    ]
+    assert is_gt_one(multi_pairing_fast(pairs))
+
+
+def test_signature_verify_via_fast_pairing():
+    sk = bls.keygen(b"\x01" * 32)
+    pk = bls.sk_to_pk(sk)
+    msg = b"fast pairing verify"
+    sig = bls.sign(sk, msg)
+    h = hash_to_g2(msg, bls.DST_POP)
+    # e(-G1, sig) * e(pk, H(m)) == 1
+    assert is_gt_one(multi_pairing_fast([(sig, g1_neg(G1_GEN)), (h, pk)]))
+    # and a wrong message fails
+    h_bad = hash_to_g2(b"other", bls.DST_POP)
+    assert not is_gt_one(
+        multi_pairing_fast([(sig, g1_neg(G1_GEN)), (h_bad, pk)])
+    )
+
+
+def test_skips_identity_pairs():
+    pairs = rand_pairs(2)
+    with_identity = pairs + [(None, G1_GEN), (G2_GEN, None)]
+    assert multi_pairing_fast(with_identity) == multi_pairing_fast(pairs)
+    assert miller_loop_projective([]) == FP12_ONE
