@@ -1,0 +1,22 @@
+"""JAX/TPU batched BLS12-381 engine — the framework's compute hot path.
+
+Where the reference calls herumi's C++/asm BLS one signature at a time
+(ref: tbls/herumi.go), this package executes *batches* of field/curve/pairing
+operations as single XLA programs, sharded over a TPU mesh by
+charon_tpu/parallel.
+
+Layout:
+  limb.py    generic multi-limb Montgomery modular arithmetic (24-bit limbs)
+  fptower.py Fp2/Fp6/Fp12 tower with stacked (vectorized) multiplications
+  curve.py   G1/G2 Jacobian point ops, batched scalar-mul, MSM
+  pairing.py batched multi-pairing (projective Miller loop + final exp),
+             mirroring charon_tpu/crypto/pairing_fast.py exactly
+  blsops.py  the user-facing batched BLS operations
+
+uint64 limb storage requires x64 mode; enable it on import, before any
+array is created.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

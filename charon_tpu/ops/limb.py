@@ -1,0 +1,303 @@
+"""Batched multi-limb Montgomery arithmetic for big prime fields on TPU.
+
+Representation: an element of Z/m is a little-endian vector of `n_limbs`
+24-bit limbs stored as uint64, shape (..., n_limbs); leading axes are batch
+axes. All public ops accept arbitrary broadcastable batch shapes and keep
+values fully reduced (< m).
+
+Why 24-bit limbs:
+  * products of two limbs are < 2^48, so a full 16-term schoolbook column
+    plus Montgomery additions stays < 2^54 — far from uint64 overflow,
+    which means NO carry normalization is needed inside the hot loops
+    (one carry pass at the end of a multiply);
+  * 24 bits = 3 bytes, so host packing is a pure-numpy byte reshuffle;
+  * 24 = 3 x 8 keeps a future Pallas int8-MXU decomposition aligned.
+
+Montgomery domain: R = 2^(24 * n_limbs). `mont_mul(a, b) = a*b*R^-1 mod m`.
+Values enter the domain with `to_mont` (device) and leave with `from_mont`.
+
+This file is generic over the modulus (instantiated for BLS12-381 Fp and Fr
+at the bottom) and is the device-side counterpart of
+charon_tpu/crypto/fields.py, which serves as its correctness oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from charon_tpu.crypto.fields import P, R as FR_MOD
+
+LIMB_BITS = 24
+LIMB_BYTES = 3
+MASK = (1 << LIMB_BITS) - 1
+
+_U64 = jnp.uint64
+
+
+def _u(x):
+    """Python int -> uint64 scalar constant."""
+    return jnp.uint64(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModCtx:
+    """Everything the device needs to do arithmetic mod `modulus`."""
+
+    name: str
+    modulus: int
+    n_limbs: int
+    limbs: np.ndarray  # (n_limbs,) uint64 — the modulus
+    pinv: int  # -modulus^-1 mod 2^24
+    r2: np.ndarray  # (n_limbs,) — R^2 mod m (to_mont multiplier)
+    mont_one: np.ndarray  # (n_limbs,) — R mod m (1 in Montgomery form)
+
+    @property
+    def r_mont(self) -> int:
+        return (1 << (LIMB_BITS * self.n_limbs)) % self.modulus
+
+
+def int_to_limbs(x: int, n_limbs: int) -> np.ndarray:
+    out = np.empty(n_limbs, np.uint64)
+    for i in range(n_limbs):
+        out[i] = (x >> (LIMB_BITS * i)) & MASK
+    return out
+
+
+def make_ctx(name: str, modulus: int, n_limbs: int) -> ModCtx:
+    if modulus.bit_length() > LIMB_BITS * n_limbs - 2:
+        raise ValueError("need >= 2 bits of headroom above the modulus")
+    r = 1 << (LIMB_BITS * n_limbs)
+    return ModCtx(
+        name=name,
+        modulus=modulus,
+        n_limbs=n_limbs,
+        limbs=int_to_limbs(modulus, n_limbs),
+        pinv=(-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS),
+        r2=int_to_limbs(r * r % modulus, n_limbs),
+        mont_one=int_to_limbs(r % modulus, n_limbs),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device packing (pure numpy, byte-aligned thanks to 24-bit limbs)
+# ---------------------------------------------------------------------------
+
+
+def pack(values, n_limbs: int) -> np.ndarray:
+    """List/iterable of ints -> (N, n_limbs) uint64 limb array."""
+    vals = list(values)
+    nbytes = n_limbs * LIMB_BYTES
+    buf = b"".join(int(v).to_bytes(nbytes, "little") for v in vals)
+    raw = np.frombuffer(buf, np.uint8).reshape(len(vals), n_limbs, LIMB_BYTES)
+    raw = raw.astype(np.uint64)
+    return raw[..., 0] | (raw[..., 1] << np.uint64(8)) | (raw[..., 2] << np.uint64(16))
+
+
+def unpack(arr) -> list[int]:
+    """(..., n_limbs) limb array -> flat list of ints (C-order batch)."""
+    arr = np.asarray(arr, np.uint64).reshape(-1, np.shape(arr)[-1])
+    out = []
+    for row in arr:
+        v = 0
+        for i, limb in enumerate(row):
+            v |= int(limb) << (LIMB_BITS * i)
+        out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Carry / borrow scans along the limb axis
+# ---------------------------------------------------------------------------
+
+
+def _carry_pass(a):
+    """Normalize limbs to < 2^24, propagating carries. Assumes the true
+    value fits in n_limbs limbs (carry out of the top limb would be lost)."""
+    xs = jnp.moveaxis(a, -1, 0)
+
+    def step(c, x):
+        x = x + c
+        return x >> LIMB_BITS, x & _u(MASK)
+
+    _, ys = lax.scan(step, jnp.zeros(a.shape[:-1], _U64), xs)
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def _sub_borrow(a, b):
+    """(a - b) mod 2^(24n) limbwise, plus the final borrow flag (1 if a<b).
+
+    Inputs must be normalized (< 2^24 per limb)."""
+    xs = jnp.moveaxis(jnp.stack([a, b], axis=0), -1, 0)  # (L, 2, ...)
+
+    def step(borrow, x):
+        d = x[0] + _u(1 << LIMB_BITS) - x[1] - borrow
+        return _u(1) - (d >> LIMB_BITS), d & _u(MASK)
+
+    borrow, ys = lax.scan(step, jnp.zeros(a.shape[:-1], _U64), xs)
+    return jnp.moveaxis(ys, 0, -1), borrow
+
+
+def _cond_sub(ctx: ModCtx, a):
+    """a - m if a >= m else a, for normalized a < 2m."""
+    p = jnp.asarray(ctx.limbs)
+    d, borrow = _sub_borrow(a, jnp.broadcast_to(p, a.shape))
+    return jnp.where((borrow == 0)[..., None], d, a)
+
+
+# ---------------------------------------------------------------------------
+# Modular add / sub / neg / select
+# ---------------------------------------------------------------------------
+
+
+def add_mod(ctx: ModCtx, a, b):
+    return _cond_sub(ctx, _carry_pass(a + b))
+
+
+def sub_mod(ctx: ModCtx, a, b):
+    a, b = jnp.broadcast_arrays(a, b)
+    d, borrow = _sub_borrow(a, b)
+    p = jnp.asarray(ctx.limbs)
+    d_plus_p = _carry_pass(d + p)  # wraps mod 2^(24n): == a - b + m
+    return jnp.where((borrow == 1)[..., None], d_plus_p, d)
+
+
+def neg_mod(ctx: ModCtx, a):
+    return sub_mod(ctx, jnp.zeros_like(a), a)
+
+
+def double_mod(ctx: ModCtx, a):
+    return add_mod(ctx, a, a)
+
+
+def triple_mod(ctx: ModCtx, a):
+    return add_mod(ctx, double_mod(ctx, a), a)
+
+
+def is_zero(a):
+    """Boolean mask over batch dims: element == 0 (must be reduced)."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def select(mask, a, b):
+    """Elementwise: mask ? a : b, with mask over batch dims."""
+    return jnp.where(mask[..., None], a, b)
+
+
+def zeros(ctx: ModCtx, batch_shape=()):
+    return jnp.zeros((*batch_shape, ctx.n_limbs), _U64)
+
+
+def const(ctx: ModCtx, value: int, batch_shape=()):
+    """Montgomery-form constant broadcast to a batch shape."""
+    limbs = int_to_limbs(value * ctx.r_mont % ctx.modulus, ctx.n_limbs)
+    return jnp.broadcast_to(jnp.asarray(limbs), (*batch_shape, ctx.n_limbs))
+
+
+# ---------------------------------------------------------------------------
+# Montgomery multiplication
+# ---------------------------------------------------------------------------
+
+
+def mont_mul(ctx: ModCtx, a, b):
+    """a * b * R^-1 mod m for reduced Montgomery-form inputs.
+
+    Schoolbook product into 2n columns (each < 2^53 — no mid-loop carries
+    needed), then n word-reduction rounds as a scan, shifting one limb per
+    round, then one carry pass and one conditional subtract.
+    """
+    a, b = jnp.broadcast_arrays(a, b)
+    n = ctx.n_limbs
+    outer = a[..., :, None] * b[..., None, :]  # (..., n, n)
+    t = jnp.zeros(a.shape[:-1] + (2 * n,), _U64)
+    for i in range(n):
+        t = t.at[..., i : i + n].add(outer[..., i, :])
+
+    p = jnp.asarray(ctx.limbs)
+    pinv = _u(ctx.pinv)
+
+    def round_(t, _):
+        m = (t[..., 0] * pinv) & _u(MASK)
+        t = t.at[..., :n].add(m[..., None] * p)
+        carry = t[..., 0] >> LIMB_BITS
+        t = jnp.concatenate([t[..., 1:], jnp.zeros_like(t[..., :1])], axis=-1)
+        t = t.at[..., 0].add(carry)
+        return t, None
+
+    t, _ = lax.scan(round_, t, None, length=n)
+    return _cond_sub(ctx, _carry_pass(t[..., :n]))
+
+
+def mont_sqr(ctx: ModCtx, a):
+    return mont_mul(ctx, a, a)
+
+
+def to_mont(ctx: ModCtx, a):
+    """Raw limbs (< m) -> Montgomery form, on device."""
+    return mont_mul(ctx, a, jnp.asarray(ctx.r2))
+
+
+def from_mont(ctx: ModCtx, a):
+    """Montgomery form -> raw limbs, on device."""
+    one = jnp.zeros_like(a).at[..., 0].set(_u(1))
+    return mont_mul(ctx, a, one)
+
+
+# ---------------------------------------------------------------------------
+# Exponentiation by a static exponent (lax.scan over its bits)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _exp_bits(exponent: int):
+    """MSB-first bit array of a static exponent."""
+    return np.array([int(c) for c in bin(exponent)[2:]], np.uint8)
+
+
+def mont_pow(ctx: ModCtx, a, exponent: int):
+    """a^exponent (Montgomery in, Montgomery out), square-and-multiply as a
+    scan over the (static) exponent bits."""
+    if exponent == 0:
+        return jnp.broadcast_to(jnp.asarray(ctx.mont_one), a.shape)
+    bits = jnp.asarray(_exp_bits(exponent))
+
+    def step(acc, bit):
+        acc = mont_sqr(ctx, acc)
+        mul = mont_mul(ctx, acc, a)
+        return jnp.where(bit != 0, mul, acc), None
+
+    # First bit is the leading 1: start from a directly.
+    acc, _ = lax.scan(step, a, bits[1:])
+    return acc
+
+
+def inv_mod(ctx: ModCtx, a):
+    """a^-1 via Fermat (Montgomery in/out). 0 maps to 0."""
+    return mont_pow(ctx, a, ctx.modulus - 2)
+
+
+# ---------------------------------------------------------------------------
+# Field contexts
+# ---------------------------------------------------------------------------
+
+# Fp: 381 bits -> 16 x 24 = 384 bits (2 bits headroom? 384-381=3 ✓)
+FP = make_ctx("fp", P, 16)
+# Fr: 255 bits -> 11 x 24 = 264 bits
+FR = make_ctx("fr", FR_MOD, 11)
+
+
+def pack_mont_host(ctx: ModCtx, values) -> np.ndarray:
+    """Host-side convenience: ints -> Montgomery limb array (host bigint
+    conversion; prefer to_mont-on-device for large batches)."""
+    r = ctx.r_mont
+    return pack((v % ctx.modulus * r % ctx.modulus for v in values), ctx.n_limbs)
+
+
+def unpack_mont_host(ctx: ModCtx, arr) -> list[int]:
+    rinv = pow(ctx.r_mont, -1, ctx.modulus)
+    return [v * rinv % ctx.modulus for v in unpack(arr)]

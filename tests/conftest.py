@@ -5,14 +5,23 @@ validated somewhere: we follow the reference's simnet-in-one-process strategy
 (ref: testutil/integration/simnet_test.go) by running all sharding tests on a
 virtual 8-device CPU mesh (xla_force_host_platform_device_count).
 
-This must run before jax is imported anywhere.
+Platform pinning: this image preloads an `axon` TPU PJRT plugin via
+sitecustomize, whose register() sets jax_platforms="axon,cpu" through
+jax.config — overriding the JAX_PLATFORMS env var. Tests must never touch
+the TPU tunnel (a backend claim can block for minutes), so we override the
+config back to cpu *after* jax import; that wins because no backend has
+been initialized yet at conftest time.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

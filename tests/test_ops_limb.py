@@ -1,0 +1,102 @@
+"""Limb-engine arithmetic vs Python bigint ground truth."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from charon_tpu.crypto.fields import P, R
+from charon_tpu.ops import limb
+
+rng = random.Random(1234)
+
+
+def rand_elems(n, mod):
+    return [rng.randrange(mod) for _ in range(n)]
+
+
+def to_dev(ctx, vals):
+    return limb.to_mont(ctx, jnp.asarray(limb.pack(vals, ctx.n_limbs)))
+
+
+def from_dev(ctx, arr):
+    return limb.unpack(limb.from_mont(ctx, arr))
+
+
+def test_pack_unpack_roundtrip():
+    vals = rand_elems(7, P)
+    arr = limb.pack(vals, limb.FP.n_limbs)
+    assert limb.unpack(arr) == vals
+
+
+def test_mont_roundtrip_and_domain():
+    vals = rand_elems(5, P)
+    dev = to_dev(limb.FP, vals)
+    assert from_dev(limb.FP, dev) == vals
+    # host-side Montgomery packing agrees with device to_mont
+    host = limb.pack_mont_host(limb.FP, vals)
+    assert np.array_equal(np.asarray(dev), host)
+
+
+def test_add_sub_neg_double_triple():
+    ctx = limb.FP
+    a_v = rand_elems(64, P)
+    b_v = rand_elems(64, P)
+    a, b = to_dev(ctx, a_v), to_dev(ctx, b_v)
+    assert from_dev(ctx, limb.add_mod(ctx, a, b)) == [
+        (x + y) % P for x, y in zip(a_v, b_v)
+    ]
+    assert from_dev(ctx, limb.sub_mod(ctx, a, b)) == [
+        (x - y) % P for x, y in zip(a_v, b_v)
+    ]
+    assert from_dev(ctx, limb.neg_mod(ctx, a)) == [(-x) % P for x in a_v]
+    assert from_dev(ctx, limb.double_mod(ctx, a)) == [2 * x % P for x in a_v]
+    assert from_dev(ctx, limb.triple_mod(ctx, a)) == [3 * x % P for x in a_v]
+
+
+def test_mont_mul_matches_bigint():
+    ctx = limb.FP
+    # include edge values
+    a_v = [0, 1, P - 1, P - 2] + rand_elems(60, P)
+    b_v = [P - 1, 0, P - 1, 1] + rand_elems(60, P)
+    a, b = to_dev(ctx, a_v), to_dev(ctx, b_v)
+    got = from_dev(ctx, limb.mont_mul(ctx, a, b))
+    assert got == [x * y % P for x, y in zip(a_v, b_v)]
+
+
+def test_mont_mul_broadcasts():
+    ctx = limb.FP
+    a_v = rand_elems(6, P)
+    b_v = rand_elems(1, P)
+    a, b = to_dev(ctx, a_v), to_dev(ctx, b_v)
+    got = from_dev(ctx, limb.mont_mul(ctx, a.reshape(2, 3, -1), b))
+    assert got == [x * b_v[0] % P for x in a_v]
+
+
+def test_pow_and_inv():
+    ctx = limb.FP
+    a_v = rand_elems(8, P)
+    a = to_dev(ctx, a_v)
+    assert from_dev(ctx, limb.mont_pow(ctx, a, 5)) == [pow(x, 5, P) for x in a_v]
+    inv = limb.inv_mod(ctx, a)
+    assert from_dev(ctx, inv) == [pow(x, P - 2, P) for x in a_v]
+
+
+def test_fr_context():
+    ctx = limb.FR
+    a_v = rand_elems(16, R)
+    b_v = rand_elems(16, R)
+    a, b = to_dev(ctx, a_v), to_dev(ctx, b_v)
+    assert from_dev(ctx, limb.mont_mul(ctx, a, b)) == [
+        x * y % R for x, y in zip(a_v, b_v)
+    ]
+
+
+def test_is_zero_and_select():
+    ctx = limb.FP
+    a = to_dev(ctx, [0, 5, 0])
+    mask = limb.is_zero(limb.from_mont(ctx, a))
+    assert list(np.asarray(mask)) == [True, False, True]
+    b = to_dev(ctx, [7, 8, 9])
+    sel = limb.select(mask, a, b)
+    assert from_dev(ctx, sel) == [0, 8, 0]
