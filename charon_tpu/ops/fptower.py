@@ -1,0 +1,420 @@
+"""Batched BLS12-381 extension-field towers on the limb engine.
+
+Mirrors charon_tpu/crypto/fields.py (the executable specification) with
+Montgomery limb arrays in place of Python ints:
+
+    Fp2  = Fp[u]  / (u^2 + 1)        tuple (c0, c1) of (..., n_limbs) arrays
+    Fp6  = Fp2[v] / (v^3 - xi)       tuple of three Fp2, xi = 1 + u
+    Fp12 = Fp6[w] / (w^2 - v)        tuple of two Fp6
+
+All elements are JAX pytrees, so they flow through jit/scan/cond/vmap
+unchanged. Every function takes the Fp ModCtx first so the same code runs
+on the 24-bit/uint64 (CPU) and 12-bit/uint32 (TPU) limb geometries.
+
+Multiplication counts (in Fp mont_muls): fp2_mul 3 (Karatsuba), fp2_sqr 2,
+fp6_mul 18, fp12_mul 54, fp12_cyclotomic_sqr 18 (Granger–Scott).
+
+Plays the role of herumi's field tower (ref: tbls/herumi.go:25-36 links the
+C++/asm backend); the reference has no batched equivalent — this is the
+TPU-first redesign.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.ops import limb
+from charon_tpu.ops.limb import ModCtx
+
+# ---------------------------------------------------------------------------
+# Fp2
+# ---------------------------------------------------------------------------
+
+
+def fp2_zero(ctx: ModCtx, batch_shape=()):
+    return (limb.zeros(ctx, batch_shape), limb.zeros(ctx, batch_shape))
+
+
+def fp2_one(ctx: ModCtx, batch_shape=()):
+    return (limb.const(ctx, 1, batch_shape), limb.zeros(ctx, batch_shape))
+
+
+def fp2_const(ctx: ModCtx, a, batch_shape=()):
+    """Python-int pair (c0, c1) -> broadcast Montgomery constant."""
+    return (
+        limb.const(ctx, a[0], batch_shape),
+        limb.const(ctx, a[1], batch_shape),
+    )
+
+
+def fp2_add(ctx, a, b):
+    return (limb.add_mod(ctx, a[0], b[0]), limb.add_mod(ctx, a[1], b[1]))
+
+
+def fp2_sub(ctx, a, b):
+    return (limb.sub_mod(ctx, a[0], b[0]), limb.sub_mod(ctx, a[1], b[1]))
+
+
+def fp2_neg(ctx, a):
+    return (limb.neg_mod(ctx, a[0]), limb.neg_mod(ctx, a[1]))
+
+
+def fp2_double(ctx, a):
+    return (limb.double_mod(ctx, a[0]), limb.double_mod(ctx, a[1]))
+
+
+def fp2_mul(ctx, a, b):
+    """Karatsuba: 3 base muls.
+
+    c0 = a0 b0 - a1 b1;  c1 = (a0+a1)(b0+b1) - a0 b0 - a1 b1.
+    """
+    v0 = limb.mont_mul(ctx, a[0], b[0])
+    v1 = limb.mont_mul(ctx, a[1], b[1])
+    s = limb.mont_mul(
+        ctx,
+        limb.add_mod(ctx, a[0], a[1]),
+        limb.add_mod(ctx, b[0], b[1]),
+    )
+    return (
+        limb.sub_mod(ctx, v0, v1),
+        limb.sub_mod(ctx, limb.sub_mod(ctx, s, v0), v1),
+    )
+
+
+def fp2_sqr(ctx, a):
+    """(a0+a1)(a0-a1) + 2 a0 a1 u — 2 base muls."""
+    c0 = limb.mont_mul(
+        ctx,
+        limb.add_mod(ctx, a[0], a[1]),
+        limb.sub_mod(ctx, a[0], a[1]),
+    )
+    c1 = limb.double_mod(ctx, limb.mont_mul(ctx, a[0], a[1]))
+    return (c0, c1)
+
+
+def fp2_mul_fp(ctx, a, s):
+    """Multiply an Fp2 element by a (batched, Montgomery) Fp element."""
+    return (limb.mont_mul(ctx, a[0], s), limb.mont_mul(ctx, a[1], s))
+
+
+def fp2_small(ctx, a, k: int):
+    """Multiply by a small static non-negative int via a double/add chain."""
+    if k == 0:
+        return fp2_zero(ctx, a[0].shape[:-1])
+    acc = None
+    add = a
+    while k:
+        if k & 1:
+            acc = add if acc is None else fp2_add(ctx, acc, add)
+        k >>= 1
+        if k:
+            add = fp2_double(ctx, add)
+    return acc
+
+
+def fp2_mul_xi(ctx, a):
+    """Multiply by xi = 1 + u: (a0 - a1) + (a0 + a1) u."""
+    return (limb.sub_mod(ctx, a[0], a[1]), limb.add_mod(ctx, a[0], a[1]))
+
+
+def fp2_conj(ctx, a):
+    return (a[0], limb.neg_mod(ctx, a[1]))
+
+
+def fp2_inv(ctx, a):
+    """Batched inverse: conj(a) / norm(a), norm inverted via Fermat.
+
+    0 maps to 0 (inv_mod(0) == 0), which keeps identity-point lanes inert in
+    batched curve code.
+    """
+    norm = limb.add_mod(
+        ctx,
+        limb.mont_sqr(ctx, a[0]),
+        limb.mont_sqr(ctx, a[1]),
+    )
+    ninv = limb.inv_mod(ctx, norm)
+    return (
+        limb.mont_mul(ctx, a[0], ninv),
+        limb.neg_mod(ctx, limb.mont_mul(ctx, a[1], ninv)),
+    )
+
+
+def fp2_is_zero(a):
+    return jnp.logical_and(limb.is_zero(a[0]), limb.is_zero(a[1]))
+
+
+def fp2_eq(a, b):
+    return jnp.logical_and(
+        jnp.all(a[0] == b[0], axis=-1), jnp.all(a[1] == b[1], axis=-1)
+    )
+
+
+def fp2_select(mask, a, b):
+    return (limb.select(mask, a[0], b[0]), limb.select(mask, a[1], b[1]))
+
+
+# ---------------------------------------------------------------------------
+# Fp6
+# ---------------------------------------------------------------------------
+
+
+def fp6_zero(ctx, batch_shape=()):
+    return tuple(fp2_zero(ctx, batch_shape) for _ in range(3))
+
+
+def fp6_one(ctx, batch_shape=()):
+    return (
+        fp2_one(ctx, batch_shape),
+        fp2_zero(ctx, batch_shape),
+        fp2_zero(ctx, batch_shape),
+    )
+
+
+def fp6_add(ctx, a, b):
+    return tuple(fp2_add(ctx, x, y) for x, y in zip(a, b))
+
+
+def fp6_sub(ctx, a, b):
+    return tuple(fp2_sub(ctx, x, y) for x, y in zip(a, b))
+
+
+def fp6_neg(ctx, a):
+    return tuple(fp2_neg(ctx, x) for x in a)
+
+
+def fp6_mul(ctx, a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t00 = fp2_mul(ctx, a0, b0)
+    t11 = fp2_mul(ctx, a1, b1)
+    t22 = fp2_mul(ctx, a2, b2)
+    c0 = fp2_add(
+        ctx,
+        t00,
+        fp2_mul_xi(
+            ctx,
+            fp2_add(ctx, fp2_mul(ctx, a1, b2), fp2_mul(ctx, a2, b1)),
+        ),
+    )
+    c1 = fp2_add(
+        ctx,
+        fp2_add(ctx, fp2_mul(ctx, a0, b1), fp2_mul(ctx, a1, b0)),
+        fp2_mul_xi(ctx, t22),
+    )
+    c2 = fp2_add(
+        ctx,
+        fp2_add(ctx, fp2_mul(ctx, a0, b2), fp2_mul(ctx, a2, b0)),
+        t11,
+    )
+    return (c0, c1, c2)
+
+
+def fp6_sqr(ctx, a):
+    return fp6_mul(ctx, a, a)
+
+
+def fp6_mul_by_v(ctx, a):
+    """v * (a0 + a1 v + a2 v^2) = xi*a2 + a0 v + a1 v^2."""
+    return (fp2_mul_xi(ctx, a[2]), a[0], a[1])
+
+
+def fp6_inv(ctx, a):
+    a0, a1, a2 = a
+    t0 = fp2_sub(ctx, fp2_sqr(ctx, a0), fp2_mul_xi(ctx, fp2_mul(ctx, a1, a2)))
+    t1 = fp2_sub(ctx, fp2_mul_xi(ctx, fp2_sqr(ctx, a2)), fp2_mul(ctx, a0, a1))
+    t2 = fp2_sub(ctx, fp2_sqr(ctx, a1), fp2_mul(ctx, a0, a2))
+    d = fp2_add(
+        ctx,
+        fp2_mul(ctx, a0, t0),
+        fp2_mul_xi(
+            ctx,
+            fp2_add(ctx, fp2_mul(ctx, a2, t1), fp2_mul(ctx, a1, t2)),
+        ),
+    )
+    dinv = fp2_inv(ctx, d)
+    return (
+        fp2_mul(ctx, t0, dinv),
+        fp2_mul(ctx, t1, dinv),
+        fp2_mul(ctx, t2, dinv),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fp12
+# ---------------------------------------------------------------------------
+
+
+def fp12_one(ctx, batch_shape=()):
+    return (fp6_one(ctx, batch_shape), fp6_zero(ctx, batch_shape))
+
+
+def fp12_mul(ctx, a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(ctx, a0, b0)
+    t1 = fp6_mul(ctx, a1, b1)
+    c0 = fp6_add(ctx, t0, fp6_mul_by_v(ctx, t1))
+    c1 = fp6_add(ctx, fp6_mul(ctx, a0, b1), fp6_mul(ctx, a1, b0))
+    return (c0, c1)
+
+
+def fp12_sqr(ctx, a):
+    """Generic square (the cyclotomic variant below is 3x cheaper but only
+    valid after the easy part of the final exponentiation)."""
+    return fp12_mul(ctx, a, a)
+
+
+def fp12_conj(ctx, a):
+    """f^(p^6): negates the w coefficient. Equals f^-1 for unitary f."""
+    return (a[0], fp6_neg(ctx, a[1]))
+
+
+def fp12_inv(ctx, a):
+    a0, a1 = a
+    d = fp6_sub(ctx, fp6_sqr(ctx, a0), fp6_mul_by_v(ctx, fp6_sqr(ctx, a1)))
+    dinv = fp6_inv(ctx, d)
+    return (fp6_mul(ctx, a0, dinv), fp6_neg(ctx, fp6_mul(ctx, a1, dinv)))
+
+
+def fp12_select(mask, a, b):
+    return tuple(
+        tuple(
+            fp2_select(mask, x, y)
+            for x, y in zip(a6, b6)
+        )
+        for a6, b6 in zip(a, b)
+    )
+
+
+def fp12_is_one(ctx, a):
+    """Batch mask: element == 1 (inputs in Montgomery form)."""
+    one = limb.const(ctx, 1, a[0][0][0].shape[:-1])
+    ok = jnp.all(a[0][0][0] == one, axis=-1)
+    ok = jnp.logical_and(ok, limb.is_zero(a[0][0][1]))
+    for c6 in (a[0][1], a[0][2], a[1][0], a[1][1], a[1][2]):
+        ok = jnp.logical_and(ok, fp2_is_zero(c6))
+    return ok
+
+
+# Frobenius: gamma6 = xi^((p-1)/6); the (i, j) coefficient (of v^j w^i) is
+# multiplied by gamma6^(2j+i) after Fp2 conjugation (ref spec:
+# charon_tpu/crypto/fields.py fp12_frobenius).
+@functools.lru_cache(maxsize=None)
+def _gamma_pows() -> tuple:
+    g = F.fp2_pow(F.XI, (F.P - 1) // 6)
+    pows = [F.FP2_ONE]
+    for _ in range(5):
+        pows.append(F.fp2_mul(pows[-1], g))
+    return tuple(pows)
+
+
+def fp12_frobenius(ctx, a):
+    pows = _gamma_pows()
+    out6 = []
+    for i in range(2):
+        coeffs = []
+        for j in range(3):
+            c = fp2_conj(ctx, a[i][j])
+            k = 2 * j + i
+            if k == 0:
+                coeffs.append(c)
+            else:
+                coeffs.append(fp2_mul(ctx, c, fp2_const(ctx, pows[k])))
+        out6.append(tuple(coeffs))
+    return tuple(out6)
+
+
+def fp12_frobenius_n(ctx, a, n: int):
+    for _ in range(n):
+        a = fp12_frobenius(ctx, a)
+    return a
+
+
+def fp12_cyclotomic_sqr(ctx, a):
+    """Granger–Scott squaring for unitary elements (post easy-part): 9 fp2
+    squarings = 18 base muls vs 54 for a generic fp12_mul.
+
+    With z = (c0, c1, c2) + (c3, c4, c5) w:
+        t0..t5 as below, out = 3*t - 2*z (conjugate-flavored signs).
+    """
+    (c0, c1, c2), (c3, c4, c5) = a
+
+    def sq(x):
+        return fp2_sqr(ctx, x)
+
+    t0 = sq(c4)
+    t1 = sq(c0)
+    t6 = fp2_sub(ctx, sq(fp2_add(ctx, c4, c0)), fp2_add(ctx, t0, t1))  # 2 c0 c4
+    t2 = sq(c2)
+    t3 = sq(c3)
+    t7 = fp2_sub(ctx, sq(fp2_add(ctx, c2, c3)), fp2_add(ctx, t2, t3))  # 2 c2 c3
+    t4 = sq(c5)
+    t5 = sq(c1)
+    t8 = fp2_mul_xi(
+        ctx,
+        fp2_sub(ctx, sq(fp2_add(ctx, c5, c1)), fp2_add(ctx, t4, t5)),
+    )  # 2 c1 c5 xi
+    t0 = fp2_add(ctx, fp2_mul_xi(ctx, t0), t1)  # c0^2 + xi c4^2
+    t2 = fp2_add(ctx, fp2_mul_xi(ctx, t2), t3)
+    t4 = fp2_add(ctx, fp2_mul_xi(ctx, t4), t5)
+
+    def out_c0(t, c):  # 3t - 2c
+        return fp2_sub(ctx, fp2_small(ctx, t, 3), fp2_double(ctx, c))
+
+    def out_c1(t, c):  # 3t + 2c
+        return fp2_add(ctx, fp2_small(ctx, t, 3), fp2_double(ctx, c))
+
+    return (
+        (out_c0(t0, c0), out_c0(t2, c1), out_c0(t4, c2)),
+        (out_c1(t8, c3), out_c1(t6, c4), out_c1(t7, c5)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion helpers (tower elements <-> Python-int tuples)
+# ---------------------------------------------------------------------------
+
+
+def fp2_pack(ctx, values):
+    """Iterable of Python Fp2 tuples -> batched device Fp2 (Montgomery)."""
+    vals = list(values)
+    return (
+        jnp.asarray(limb.pack_mont_host(ctx, [v[0] for v in vals])),
+        jnp.asarray(limb.pack_mont_host(ctx, [v[1] for v in vals])),
+    )
+
+
+def fp2_unpack(ctx, a) -> list:
+    c0 = limb.unpack_mont_host(ctx, a[0])
+    c1 = limb.unpack_mont_host(ctx, a[1])
+    return list(zip(c0, c1))
+
+
+def fp12_pack(ctx, values):
+    """Iterable of Python Fp12 tower tuples -> batched device Fp12."""
+    vals = list(values)
+    return tuple(
+        tuple(
+            fp2_pack(ctx, [v[i][j] for v in vals])
+            for j in range(3)
+        )
+        for i in range(2)
+    )
+
+
+def fp12_unpack(ctx, a) -> list:
+    per_coeff = [
+        [fp2_unpack(ctx, a[i][j]) for j in range(3)]
+        for i in range(2)
+    ]
+    n = len(per_coeff[0][0])
+    return [
+        tuple(
+            tuple(per_coeff[i][j][k] for j in range(3))
+            for i in range(2)
+        )
+        for k in range(n)
+    ]

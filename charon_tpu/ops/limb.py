@@ -1,20 +1,30 @@
 """Batched multi-limb Montgomery arithmetic for big prime fields on TPU.
 
 Representation: an element of Z/m is a little-endian vector of `n_limbs`
-24-bit limbs stored as uint64, shape (..., n_limbs); leading axes are batch
-axes. All public ops accept arbitrary broadcastable batch shapes and keep
-values fully reduced (< m).
+limbs of `limb_bits` bits stored as `dtype`, shape (..., n_limbs); leading
+axes are batch axes. All public ops accept arbitrary broadcastable batch
+shapes and keep values fully reduced (< m).
 
-Why 24-bit limbs:
-  * products of two limbs are < 2^48, so a full 16-term schoolbook column
-    plus Montgomery additions stays < 2^54 — far from uint64 overflow,
-    which means NO carry normalization is needed inside the hot loops
-    (one carry pass at the end of a multiply);
-  * 24 bits = 3 bytes, so host packing is a pure-numpy byte reshuffle;
-  * 24 = 3 x 8 keeps a future Pallas int8-MXU decomposition aligned.
+Two limb geometries are provided, selected per ModCtx:
 
-Montgomery domain: R = 2^(24 * n_limbs). `mont_mul(a, b) = a*b*R^-1 mod m`.
-Values enter the domain with `to_mont` (device) and leave with `from_mont`.
+  * 24-bit limbs in uint64 (CPU-friendly): products of two limbs are
+    < 2^48, so a full 16-term schoolbook column plus Montgomery additions
+    stays < 2^54 — far from uint64 overflow, which means NO carry
+    normalization is needed inside the hot loops (one carry pass at the
+    end of a multiply). 24 bits = 3 bytes, so host packing is a pure-numpy
+    byte reshuffle.
+  * 12-bit limbs in uint32 (TPU-friendly): TPUs have no native 64-bit
+    integers (XLA emulates them slowly), so the TPU contexts use 12-bit
+    limbs whose products fit 24 bits; a 32-term column plus Montgomery
+    additions stays < 2^31 in uint32. 12 = 4 + 8 keeps a future Pallas
+    int8-MXU decomposition aligned.
+
+The no-mid-loop-carry invariant (see mont_mul) is asserted in make_ctx for
+whatever geometry is requested.
+
+Montgomery domain: R = 2^(limb_bits * n_limbs). `mont_mul(a, b) =
+a*b*R^-1 mod m`. Values enter the domain with `to_mont` (device) and leave
+with `from_mont`.
 
 This file is generic over the modulus (instantiated for BLS12-381 Fp and Fr
 at the bottom) and is the device-side counterpart of
@@ -33,82 +43,118 @@ from jax import lax
 
 from charon_tpu.crypto.fields import P, R as FR_MOD
 
+# Default geometry (kept as module constants for the host packing helpers).
 LIMB_BITS = 24
 LIMB_BYTES = 3
 MASK = (1 << LIMB_BITS) - 1
 
-_U64 = jnp.uint64
 
-
-def _u(x):
-    """Python int -> uint64 scalar constant."""
-    return jnp.uint64(x)
-
-
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)  # eq=False: identity hash, so
+# module-singleton contexts work as lru_cache / static-argnum keys despite
+# holding numpy arrays.
 class ModCtx:
     """Everything the device needs to do arithmetic mod `modulus`."""
 
     name: str
     modulus: int
     n_limbs: int
-    limbs: np.ndarray  # (n_limbs,) uint64 — the modulus
-    pinv: int  # -modulus^-1 mod 2^24
+    limb_bits: int
+    np_dtype: type  # np.uint64 | np.uint32
+    limbs: np.ndarray  # (n_limbs,) — the modulus
+    pinv: int  # -modulus^-1 mod 2^limb_bits
     r2: np.ndarray  # (n_limbs,) — R^2 mod m (to_mont multiplier)
     mont_one: np.ndarray  # (n_limbs,) — R mod m (1 in Montgomery form)
 
     @property
+    def mask(self) -> int:
+        return (1 << self.limb_bits) - 1
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.np_dtype)
+
+    @property
     def r_mont(self) -> int:
-        return (1 << (LIMB_BITS * self.n_limbs)) % self.modulus
+        return (1 << (self.limb_bits * self.n_limbs)) % self.modulus
+
+    def u(self, x: int):
+        """Python int -> dtype scalar constant."""
+        return jnp.asarray(x, self.dtype)
 
 
-def int_to_limbs(x: int, n_limbs: int) -> np.ndarray:
-    out = np.empty(n_limbs, np.uint64)
+def int_to_limbs(x: int, n_limbs: int, limb_bits: int = LIMB_BITS, np_dtype=np.uint64) -> np.ndarray:
+    out = np.empty(n_limbs, np_dtype)
+    mask = (1 << limb_bits) - 1
     for i in range(n_limbs):
-        out[i] = (x >> (LIMB_BITS * i)) & MASK
+        out[i] = (x >> (limb_bits * i)) & mask
     return out
 
 
-def make_ctx(name: str, modulus: int, n_limbs: int) -> ModCtx:
-    if modulus.bit_length() > LIMB_BITS * n_limbs - 2:
+def make_ctx(name: str, modulus: int, n_limbs: int, limb_bits: int = LIMB_BITS, np_dtype=np.uint64) -> ModCtx:
+    if modulus.bit_length() > limb_bits * n_limbs - 2:
         raise ValueError("need >= 2 bits of headroom above the modulus")
-    r = 1 << (LIMB_BITS * n_limbs)
+    # No-mid-loop-carry invariant: a schoolbook column of n products plus n
+    # Montgomery additions plus carries must fit the accumulator dtype.
+    acc_bits = np.dtype(np_dtype).itemsize * 8
+    worst = 2 * n_limbs * ((1 << limb_bits) - 1) ** 2 + (1 << acc_bits - 1) // (1 << limb_bits)
+    if worst >= 1 << acc_bits:
+        raise ValueError(f"limb geometry {limb_bits}b x {n_limbs} overflows {acc_bits}-bit accumulator")
+    r = 1 << (limb_bits * n_limbs)
     return ModCtx(
         name=name,
         modulus=modulus,
         n_limbs=n_limbs,
-        limbs=int_to_limbs(modulus, n_limbs),
-        pinv=(-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS),
-        r2=int_to_limbs(r * r % modulus, n_limbs),
-        mont_one=int_to_limbs(r % modulus, n_limbs),
+        limb_bits=limb_bits,
+        np_dtype=np_dtype,
+        limbs=int_to_limbs(modulus, n_limbs, limb_bits, np_dtype),
+        pinv=(-pow(modulus, -1, 1 << limb_bits)) % (1 << limb_bits),
+        r2=int_to_limbs(r * r % modulus, n_limbs, limb_bits, np_dtype),
+        mont_one=int_to_limbs(r % modulus, n_limbs, limb_bits, np_dtype),
     )
 
 
 # ---------------------------------------------------------------------------
-# Host <-> device packing (pure numpy, byte-aligned thanks to 24-bit limbs)
+# Host <-> device packing (pure numpy)
 # ---------------------------------------------------------------------------
 
 
-def pack(values, n_limbs: int) -> np.ndarray:
-    """List/iterable of ints -> (N, n_limbs) uint64 limb array."""
+def pack(values, n_limbs: int, limb_bits: int = LIMB_BITS, np_dtype=np.uint64) -> np.ndarray:
+    """List/iterable of ints -> (N, n_limbs) limb array."""
     vals = list(values)
-    nbytes = n_limbs * LIMB_BYTES
-    buf = b"".join(int(v).to_bytes(nbytes, "little") for v in vals)
-    raw = np.frombuffer(buf, np.uint8).reshape(len(vals), n_limbs, LIMB_BYTES)
-    raw = raw.astype(np.uint64)
-    return raw[..., 0] | (raw[..., 1] << np.uint64(8)) | (raw[..., 2] << np.uint64(16))
+    if limb_bits == 24:
+        nbytes = n_limbs * 3
+        buf = b"".join(int(v).to_bytes(nbytes, "little") for v in vals)
+        raw = np.frombuffer(buf, np.uint8).reshape(len(vals), n_limbs, 3)
+        raw = raw.astype(np.uint64)
+        out = raw[..., 0] | (raw[..., 1] << np.uint64(8)) | (raw[..., 2] << np.uint64(16))
+        return out.astype(np_dtype)
+    mask = (1 << limb_bits) - 1
+    out = np.empty((len(vals), n_limbs), np_dtype)
+    for r, v in enumerate(vals):
+        v = int(v)
+        for i in range(n_limbs):
+            out[r, i] = (v >> (limb_bits * i)) & mask
+    return out
 
 
-def unpack(arr) -> list[int]:
+def unpack(arr, limb_bits: int = LIMB_BITS) -> list[int]:
     """(..., n_limbs) limb array -> flat list of ints (C-order batch)."""
-    arr = np.asarray(arr, np.uint64).reshape(-1, np.shape(arr)[-1])
+    arr = np.asarray(arr).reshape(-1, np.shape(arr)[-1])
     out = []
     for row in arr:
         v = 0
         for i, limb in enumerate(row):
-            v |= int(limb) << (LIMB_BITS * i)
+            v |= int(limb) << (limb_bits * i)
         out.append(v)
     return out
+
+
+def ctx_pack(ctx: ModCtx, values) -> np.ndarray:
+    return pack(values, ctx.n_limbs, ctx.limb_bits, ctx.np_dtype)
+
+
+def ctx_unpack(ctx: ModCtx, arr) -> list[int]:
+    return unpack(arr, ctx.limb_bits)
 
 
 # ---------------------------------------------------------------------------
@@ -116,37 +162,40 @@ def unpack(arr) -> list[int]:
 # ---------------------------------------------------------------------------
 
 
-def _carry_pass(a):
-    """Normalize limbs to < 2^24, propagating carries. Assumes the true
-    value fits in n_limbs limbs (carry out of the top limb would be lost)."""
+def _carry_pass(ctx: ModCtx, a):
+    """Normalize limbs to < 2^limb_bits, propagating carries. Assumes the
+    true value fits in n_limbs limbs (carry out of the top limb is lost)."""
     xs = jnp.moveaxis(a, -1, 0)
+    mask = ctx.u(ctx.mask)
 
     def step(c, x):
         x = x + c
-        return x >> LIMB_BITS, x & _u(MASK)
+        return x >> ctx.limb_bits, x & mask
 
-    _, ys = lax.scan(step, jnp.zeros(a.shape[:-1], _U64), xs)
+    _, ys = lax.scan(step, jnp.zeros(a.shape[:-1], ctx.dtype), xs)
     return jnp.moveaxis(ys, 0, -1)
 
 
-def _sub_borrow(a, b):
-    """(a - b) mod 2^(24n) limbwise, plus the final borrow flag (1 if a<b).
-
-    Inputs must be normalized (< 2^24 per limb)."""
+def _sub_borrow(ctx: ModCtx, a, b):
+    """(a - b) mod 2^(limb_bits*n) limbwise, plus the final borrow flag
+    (1 if a<b). Inputs must be normalized (< 2^limb_bits per limb)."""
     xs = jnp.moveaxis(jnp.stack([a, b], axis=0), -1, 0)  # (L, 2, ...)
+    top = ctx.u(1 << ctx.limb_bits)
+    one = ctx.u(1)
+    mask = ctx.u(ctx.mask)
 
     def step(borrow, x):
-        d = x[0] + _u(1 << LIMB_BITS) - x[1] - borrow
-        return _u(1) - (d >> LIMB_BITS), d & _u(MASK)
+        d = x[0] + top - x[1] - borrow
+        return one - (d >> ctx.limb_bits), d & mask
 
-    borrow, ys = lax.scan(step, jnp.zeros(a.shape[:-1], _U64), xs)
+    borrow, ys = lax.scan(step, jnp.zeros(a.shape[:-1], ctx.dtype), xs)
     return jnp.moveaxis(ys, 0, -1), borrow
 
 
 def _cond_sub(ctx: ModCtx, a):
     """a - m if a >= m else a, for normalized a < 2m."""
     p = jnp.asarray(ctx.limbs)
-    d, borrow = _sub_borrow(a, jnp.broadcast_to(p, a.shape))
+    d, borrow = _sub_borrow(ctx, a, jnp.broadcast_to(p, a.shape))
     return jnp.where((borrow == 0)[..., None], d, a)
 
 
@@ -156,14 +205,14 @@ def _cond_sub(ctx: ModCtx, a):
 
 
 def add_mod(ctx: ModCtx, a, b):
-    return _cond_sub(ctx, _carry_pass(a + b))
+    return _cond_sub(ctx, _carry_pass(ctx, a + b))
 
 
 def sub_mod(ctx: ModCtx, a, b):
     a, b = jnp.broadcast_arrays(a, b)
-    d, borrow = _sub_borrow(a, b)
+    d, borrow = _sub_borrow(ctx, a, b)
     p = jnp.asarray(ctx.limbs)
-    d_plus_p = _carry_pass(d + p)  # wraps mod 2^(24n): == a - b + m
+    d_plus_p = _carry_pass(ctx, d + p)  # wraps mod 2^(bits*n): == a - b + m
     return jnp.where((borrow == 1)[..., None], d_plus_p, d)
 
 
@@ -190,12 +239,17 @@ def select(mask, a, b):
 
 
 def zeros(ctx: ModCtx, batch_shape=()):
-    return jnp.zeros((*batch_shape, ctx.n_limbs), _U64)
+    return jnp.zeros((*batch_shape, ctx.n_limbs), ctx.dtype)
 
 
 def const(ctx: ModCtx, value: int, batch_shape=()):
     """Montgomery-form constant broadcast to a batch shape."""
-    limbs = int_to_limbs(value * ctx.r_mont % ctx.modulus, ctx.n_limbs)
+    limbs = int_to_limbs(
+        value % ctx.modulus * ctx.r_mont % ctx.modulus,
+        ctx.n_limbs,
+        ctx.limb_bits,
+        ctx.np_dtype,
+    )
     return jnp.broadcast_to(jnp.asarray(limbs), (*batch_shape, ctx.n_limbs))
 
 
@@ -207,30 +261,32 @@ def const(ctx: ModCtx, value: int, batch_shape=()):
 def mont_mul(ctx: ModCtx, a, b):
     """a * b * R^-1 mod m for reduced Montgomery-form inputs.
 
-    Schoolbook product into 2n columns (each < 2^53 — no mid-loop carries
-    needed), then n word-reduction rounds as a scan, shifting one limb per
-    round, then one carry pass and one conditional subtract.
+    Schoolbook product into 2n columns (each within the accumulator's
+    headroom — no mid-loop carries needed), then n word-reduction rounds as
+    a scan, shifting one limb per round, then one carry pass and one
+    conditional subtract.
     """
     a, b = jnp.broadcast_arrays(a, b)
     n = ctx.n_limbs
     outer = a[..., :, None] * b[..., None, :]  # (..., n, n)
-    t = jnp.zeros(a.shape[:-1] + (2 * n,), _U64)
+    t = jnp.zeros(a.shape[:-1] + (2 * n,), ctx.dtype)
     for i in range(n):
         t = t.at[..., i : i + n].add(outer[..., i, :])
 
     p = jnp.asarray(ctx.limbs)
-    pinv = _u(ctx.pinv)
+    pinv = ctx.u(ctx.pinv)
+    mask = ctx.u(ctx.mask)
 
     def round_(t, _):
-        m = (t[..., 0] * pinv) & _u(MASK)
+        m = ((t[..., 0] & mask) * pinv) & mask
         t = t.at[..., :n].add(m[..., None] * p)
-        carry = t[..., 0] >> LIMB_BITS
+        carry = t[..., 0] >> ctx.limb_bits
         t = jnp.concatenate([t[..., 1:], jnp.zeros_like(t[..., :1])], axis=-1)
         t = t.at[..., 0].add(carry)
         return t, None
 
     t, _ = lax.scan(round_, t, None, length=n)
-    return _cond_sub(ctx, _carry_pass(t[..., :n]))
+    return _cond_sub(ctx, _carry_pass(ctx, t[..., :n]))
 
 
 def mont_sqr(ctx: ModCtx, a):
@@ -244,7 +300,7 @@ def to_mont(ctx: ModCtx, a):
 
 def from_mont(ctx: ModCtx, a):
     """Montgomery form -> raw limbs, on device."""
-    one = jnp.zeros_like(a).at[..., 0].set(_u(1))
+    one = jnp.zeros_like(a).at[..., 0].set(ctx.u(1))
     return mont_mul(ctx, a, one)
 
 
@@ -285,19 +341,35 @@ def inv_mod(ctx: ModCtx, a):
 # Field contexts
 # ---------------------------------------------------------------------------
 
-# Fp: 381 bits -> 16 x 24 = 384 bits (2 bits headroom? 384-381=3 ✓)
+# CPU-friendly geometry: 24-bit limbs in uint64.
+#   Fp: 381 bits -> 16 x 24 = 384 bits (3 bits headroom)
+#   Fr: 255 bits -> 11 x 24 = 264 bits
 FP = make_ctx("fp", P, 16)
-# Fr: 255 bits -> 11 x 24 = 264 bits
 FR = make_ctx("fr", FR_MOD, 11)
+
+# TPU-friendly geometry: 12-bit limbs in uint32 (TPUs lack native 64-bit
+# integer units; uint64 ops are emulated and slow there).
+#   Fp: 32 x 12 = 384 bits; Fr: 22 x 12 = 264 bits
+FP32 = make_ctx("fp32", P, 32, limb_bits=12, np_dtype=np.uint32)
+FR32 = make_ctx("fr32", FR_MOD, 22, limb_bits=12, np_dtype=np.uint32)
+
+
+def default_fp_ctx() -> ModCtx:
+    """Pick the Fp context matching the default JAX backend."""
+    return FP32 if jax.default_backend() == "tpu" else FP
+
+
+def default_fr_ctx() -> ModCtx:
+    return FR32 if jax.default_backend() == "tpu" else FR
 
 
 def pack_mont_host(ctx: ModCtx, values) -> np.ndarray:
     """Host-side convenience: ints -> Montgomery limb array (host bigint
     conversion; prefer to_mont-on-device for large batches)."""
     r = ctx.r_mont
-    return pack((v % ctx.modulus * r % ctx.modulus for v in values), ctx.n_limbs)
+    return ctx_pack(ctx, (v % ctx.modulus * r % ctx.modulus for v in values))
 
 
 def unpack_mont_host(ctx: ModCtx, arr) -> list[int]:
     rinv = pow(ctx.r_mont, -1, ctx.modulus)
-    return [v * rinv % ctx.modulus for v in unpack(arr)]
+    return [v * rinv % ctx.modulus for v in ctx_unpack(ctx, arr)]

@@ -1,0 +1,292 @@
+"""Batched optimal-ate pairing for BLS12-381 on the limb engine.
+
+Device-side counterpart of charon_tpu/crypto/pairing_fast.py (the validated
+scalar specification): projective Miller loop with unnormalized sparse
+lines, and an x-chain final exponentiation computing f^(3h) via the BLS12
+lattice identity — sound for every product-of-pairings == 1 check.
+
+Batch semantics: every function maps over arbitrary leading batch axes. A
+"pair" is (p, q) with p a batched affine G1 point (Fp limb pair) and q a
+batched affine G2 point (Fp2 pair). Identity lanes (encoded affine (0, 0))
+contribute the neutral line, so e(identity, q) == 1 per lane — matching the
+aggregate-verify semantics the workflow needs.
+
+Control flow is XLA-friendly: the Miller loop is a lax.scan over the static
+64-bit BLS parameter schedule with lax.cond for the sparse add steps (only
+6 of 63 bits are set), and the final exponentiation's x-chains are scans
+with Granger–Scott cyclotomic squarings.
+
+Replaces (batched) what the reference does one-signature-at-a-time through
+herumi's pairing (ref: tbls/herumi.go:288 Verify, tbls/herumi.go:318
+VerifyAggregate).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from charon_tpu.crypto.fields import P, X_ABS, X_IS_NEG
+from charon_tpu.crypto import g1g2 as REF
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import fptower as T
+from charon_tpu.ops import limb
+from charon_tpu.ops.limb import ModCtx
+
+# Miller-loop schedule: bits of |x| below the leading one, MSB first.
+X_BITS = np.array([int(b) for b in bin(X_ABS)[3:]], np.uint8)
+# Full bit string of |x| (used by the cyclotomic x-powers).
+X_BITS_FULL = np.array([int(b) for b in bin(X_ABS)[2:]], np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Sparse line multiplication: f * (l0 + l1 v w + l2 v^2 w)
+# ---------------------------------------------------------------------------
+
+
+def fp12_mul_sparse_line(ctx, f, l0, l1, l2):
+    """18 fp2 muls vs 36 for a dense fp12 mul (spec: pairing_fast.py:79)."""
+    (a0, a1, a2), (b0, b1, b2) = f
+    mul = functools.partial(T.fp2_mul, ctx)
+    add = functools.partial(T.fp2_add, ctx)
+    xi = functools.partial(T.fp2_mul_xi, ctx)
+
+    t0 = (mul(a0, l0), mul(a1, l0), mul(a2, l0))
+    t1 = (
+        xi(add(mul(b1, l2), mul(b2, l1))),
+        add(mul(b0, l1), xi(mul(b2, l2))),
+        add(mul(b0, l2), mul(b1, l1)),
+    )
+    c0 = (add(t0[0], xi(t1[2])), add(t0[1], t1[0]), add(t0[2], t1[1]))
+    a_l1 = (
+        xi(add(mul(a1, l2), mul(a2, l1))),
+        add(mul(a0, l1), xi(mul(a2, l2))),
+        add(mul(a0, l2), mul(a1, l1)),
+    )
+    b_l0 = (mul(b0, l0), mul(b1, l0), mul(b2, l0))
+    c1 = tuple(add(x, y) for x, y in zip(a_l1, b_l0))
+    return (c0, c1)
+
+
+# ---------------------------------------------------------------------------
+# Projective Miller-loop steps (spec: pairing_fast.py:120,149)
+# ---------------------------------------------------------------------------
+
+
+def _dbl_step(ctx, t, xp, yp):
+    """Double T and return the tangent line at P=(xp, yp) (batched Fp)."""
+    mul = functools.partial(T.fp2_mul, ctx)
+    sqr = functools.partial(T.fp2_sqr, ctx)
+    sub = functools.partial(T.fp2_sub, ctx)
+    small = functools.partial(T.fp2_small, ctx)
+    mul_fp = functools.partial(T.fp2_mul_fp, ctx)
+
+    x, y, z = t
+    w = small(sqr(x), 3)
+    s = mul(y, z)
+    bb = mul(mul(x, y), s)
+    h = sub(sqr(w), small(bb, 8))
+    y2 = sqr(y)
+
+    x3 = small(mul(h, s), 2)
+    y3 = sub(mul(w, sub(small(bb, 4), h)), small(mul(y2, sqr(s)), 8))
+    z3 = small(mul(s, sqr(s)), 8)
+
+    two_yp = limb.double_mod(ctx, yp)
+    l0 = T.fp2_mul_xi(ctx, mul_fp(mul(s, z), two_yp))
+    l1 = sub(mul(w, x), small(mul(y2, z), 2))
+    l2 = mul_fp(mul(w, z), limb.neg_mod(ctx, xp))
+    return (x3, y3, z3), (l0, l1, l2)
+
+
+def _add_step(ctx, t, q, xp, yp):
+    """Mixed add T + affine Q; chord line at P=(xp, yp)."""
+    mul = functools.partial(T.fp2_mul, ctx)
+    sqr = functools.partial(T.fp2_sqr, ctx)
+    sub = functools.partial(T.fp2_sub, ctx)
+    add = functools.partial(T.fp2_add, ctx)
+    mul_fp = functools.partial(T.fp2_mul_fp, ctx)
+
+    x, y, z = t
+    x2, y2 = q
+    theta = sub(y, mul(y2, z))
+    lam = sub(x, mul(x2, z))
+    lam2 = sqr(lam)
+    lam3 = mul(lam2, lam)
+    ww = add(sub(mul(sqr(theta), z), mul(lam2, T.fp2_double(ctx, x))), lam3)
+    x3 = mul(lam, ww)
+    y3 = sub(mul(theta, sub(mul(lam2, x), ww)), mul(lam3, y))
+    z3 = mul(lam3, z)
+
+    l0 = T.fp2_mul_xi(ctx, mul_fp(lam, yp))
+    l1 = sub(mul(theta, x2), mul(lam, y2))
+    l2 = mul_fp(theta, limb.neg_mod(ctx, xp))
+    return (x3, y3, z3), (l0, l1, l2)
+
+
+def _neutral_line(ctx, batch_shape):
+    return (
+        T.fp2_one(ctx, batch_shape),
+        T.fp2_zero(ctx, batch_shape),
+        T.fp2_zero(ctx, batch_shape),
+    )
+
+
+def _mask_line(ctx, dead_mask, line, batch_shape):
+    """Force identity-member pairs to contribute the neutral line l = 1."""
+    neutral = _neutral_line(ctx, batch_shape)
+    return tuple(
+        T.fp2_select(dead_mask, n, l) for n, l in zip(neutral, line)
+    )
+
+
+def miller_loop(ctx: ModCtx, pairs):
+    """Product of Miller loops over a static list of batched (p, q) pairs.
+
+    p: affine G1 (x, y) Fp limb arrays; q: affine G2 (x, y) Fp2 elements.
+    Affine (0, 0) lanes are identities and contribute 1.
+    """
+    batch_shape = pairs[0][0][0].shape[:-1]
+    dead = [
+        jnp.logical_and(limb.is_zero(p[0]), limb.is_zero(p[1]))
+        | jnp.logical_and(T.fp2_is_zero(q[0]), T.fp2_is_zero(q[1]))
+        for p, q in pairs
+    ]
+
+    # Initial T = (xq, yq, 1) per pair.
+    ts = tuple(
+        (q[0], q[1], T.fp2_one(ctx, batch_shape)) for _, q in pairs
+    )
+    f0 = T.fp12_one(ctx, batch_shape)
+    bits = jnp.asarray(X_BITS)
+
+    def dbl_all(carry):
+        f, ts = carry
+        new_ts = []
+        for (p, _), t, d in zip(pairs, ts, dead):
+            t2, line = _dbl_step(ctx, t, p[0], p[1])
+            line = _mask_line(ctx, d, line, batch_shape)
+            f = fp12_mul_sparse_line(ctx, f, *line)
+            new_ts.append(t2)
+        return f, tuple(new_ts)
+
+    def add_all(carry):
+        f, ts = carry
+        new_ts = []
+        for (p, q), t, d in zip(pairs, ts, dead):
+            t2, line = _add_step(ctx, t, q, p[0], p[1])
+            line = _mask_line(ctx, d, line, batch_shape)
+            f = fp12_mul_sparse_line(ctx, f, *line)
+            new_ts.append(t2)
+        return f, tuple(new_ts)
+
+    def step(carry, bit):
+        f, ts = carry
+        f = T.fp12_sqr(ctx, f)
+        f, ts = dbl_all((f, ts))
+        f, ts = lax.cond(bit != 0, add_all, lambda c: c, (f, ts))
+        return (f, ts), None
+
+    # First schedule entry skips the squaring (f == 1 — squaring is a no-op,
+    # so we just run the uniform step).
+    (f, _), _ = lax.scan(step, (f0, ts), bits)
+    if X_IS_NEG:
+        f = T.fp12_conj(ctx, f)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation (spec: pairing_fast.py:211-244)
+# ---------------------------------------------------------------------------
+
+
+def _cyc_pow_u(ctx, f):
+    """f^|x| in the cyclotomic subgroup: scan over the bits of |x| with
+    Granger–Scott squarings and a selected multiply (6 of 64 bits set)."""
+    bits = jnp.asarray(X_BITS_FULL[1:])  # leading 1: start from f
+
+    def step(acc, bit):
+        acc = T.fp12_cyclotomic_sqr(ctx, acc)
+        mul = T.fp12_mul(ctx, acc, f)
+        return jax.tree_util.tree_map(
+            lambda m, a: jnp.where(bit != 0, m, a), mul, acc
+        ), None
+
+    acc, _ = lax.scan(step, f, bits)
+    return acc
+
+
+def _cyc_pow_x(ctx, f):
+    out = _cyc_pow_u(ctx, f)
+    return T.fp12_conj(ctx, out) if X_IS_NEG else out
+
+
+def final_exp(ctx: ModCtx, f):
+    """f^(3 * (p^12-1)/r): easy part, then the lattice-identity hard part."""
+    # Easy part: f^((p^6-1)(p^2+1)) — lands in the cyclotomic subgroup.
+    f = T.fp12_mul(ctx, T.fp12_conj(ctx, f), T.fp12_inv(ctx, f))
+    m = T.fp12_mul(ctx, T.fp12_frobenius_n(ctx, f, 2), f)
+    # Hard part: m^(3h) = m^((x-1)^2 (x+p) (x^2+p^2-1)) * m^3.
+    a = T.fp12_mul(ctx, _cyc_pow_u(ctx, m), m)  # m^(u+1)
+    a = T.fp12_mul(ctx, _cyc_pow_u(ctx, a), a)  # m^((x-1)^2)
+    b = T.fp12_mul(ctx, _cyc_pow_x(ctx, a), T.fp12_frobenius(ctx, a))
+    c = T.fp12_mul(
+        ctx,
+        T.fp12_mul(
+            ctx,
+            _cyc_pow_x(ctx, _cyc_pow_x(ctx, b)),
+            T.fp12_frobenius_n(ctx, b, 2),
+        ),
+        T.fp12_conj(ctx, b),
+    )
+    m3 = T.fp12_mul(ctx, T.fp12_cyclotomic_sqr(ctx, m), m)
+    return T.fp12_mul(ctx, c, m3)
+
+
+def multi_pairing_check(ctx: ModCtx, pairs):
+    """Batch mask: prod e(p_i, q_i) == 1 (computed as the cube — sound:
+    GT has prime order r and gcd(3, r) = 1)."""
+    f = miller_loop(ctx, pairs)
+    e = final_exp(ctx, f)
+    return T.fp12_is_one(ctx, e)
+
+
+# ---------------------------------------------------------------------------
+# BLS verification kernels (eth2 flavour: pubkeys G1, signatures/messages G2)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _neg_g1_gen_consts(ctx: ModCtx):
+    x, y = REF.g1_neg(REF.G1_GEN)
+    return (
+        np.asarray(limb.pack_mont_host(ctx, [x])[0]),
+        np.asarray(limb.pack_mont_host(ctx, [y])[0]),
+    )
+
+
+def neg_g1_gen(ctx: ModCtx, batch_shape=()):
+    """-G1 generator broadcast to a batch shape (the fixed verify pair)."""
+    x, y = _neg_g1_gen_consts(ctx)
+    return (
+        jnp.broadcast_to(jnp.asarray(x), (*batch_shape, ctx.n_limbs)),
+        jnp.broadcast_to(jnp.asarray(y), (*batch_shape, ctx.n_limbs)),
+    )
+
+
+def batched_verify(ctx: ModCtx, pk, msg, sig):
+    """Per-lane BLS verify: e(pk, H(m)) == e(G1, sig), i.e.
+    e(pk, H(m)) * e(-G1, sig) == 1.
+
+    pk: batched affine G1; msg: batched affine G2 (already hashed to the
+    curve); sig: batched affine G2. Returns a bool mask over the batch.
+    """
+    batch_shape = pk[0].shape[:-1]
+    return multi_pairing_check(
+        ctx,
+        [(pk, msg), (neg_g1_gen(ctx, batch_shape), sig)],
+    )

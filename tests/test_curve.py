@@ -1,0 +1,115 @@
+"""Batched complete-projective curve ops vs the affine Python oracle."""
+
+import functools
+import random
+
+import jax
+import numpy as np
+
+from charon_tpu.crypto import g1g2 as REF
+from charon_tpu.crypto.fields import R
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import limb
+
+rng = random.Random(7)
+
+
+def rand_g1(n):
+    return [REF.g1_mul(REF.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+def rand_g2(n):
+    return [REF.g2_mul(REF.G2_GEN, rng.randrange(1, R)) for _ in range(n)]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(kind, op):
+    f = C.g1_ops(limb.FP) if kind == "g1" else C.g2_ops(limb.FP)
+    if op == "add":
+        return jax.jit(lambda p, q: C.point_to_affine(f, C.point_add(f, p, q)))
+    if op == "double":
+        return jax.jit(lambda p: C.point_to_affine(f, C.point_double(f, p)))
+    if op == "smul":
+        return jax.jit(
+            lambda p, s: C.point_to_affine(
+                f, C.point_scalar_mul(f, limb.FR, C.affine_to_point(f, p), s)
+            )
+        )
+    if op == "sum":
+        return jax.jit(
+            lambda p: C.point_to_affine(
+                f, C.point_sum(f, C.affine_to_point(f, p), axis=-1)
+            )
+        )
+    raise KeyError(op)
+
+
+def _to_proj(kind, pts):
+    if kind == "g1":
+        f = C.g1_ops(limb.FP)
+        return C.affine_to_point(f, C.g1_pack(limb.FP, pts))
+    f = C.g2_ops(limb.FP)
+    return C.affine_to_point(f, C.g2_pack(limb.FP, pts))
+
+
+def _unpack(kind, aff):
+    return (C.g1_unpack if kind == "g1" else C.g2_unpack)(limb.FP, aff)
+
+
+def test_g1_add_double_complete_cases():
+    pts = rand_g1(4)
+    # complete-formula stress: identity operands, P + P, P + (-P)
+    p_v = pts + [None, pts[0], pts[1], None]
+    q_v = pts[1:] + pts[:1] + [pts[2], pts[0], REF.g1_neg(pts[1]), None]
+    p, q = _to_proj("g1", p_v), _to_proj("g1", q_v)
+    got = _unpack("g1", _jitted("g1", "add")(p, q))
+    want = [REF.g1_add(a, b) for a, b in zip(p_v, q_v)]
+    assert got == want
+    got_dbl = _unpack("g1", _jitted("g1", "double")(p))
+    assert got_dbl == [REF.g1_double(a) for a in p_v]
+
+
+def test_g2_add_double_complete_cases():
+    pts = rand_g2(3)
+    p_v = pts + [None, pts[0]]
+    q_v = pts[1:] + pts[:1] + [pts[1], REF.g2_neg(pts[0])]
+    p, q = _to_proj("g2", p_v), _to_proj("g2", q_v)
+    got = _unpack("g2", _jitted("g2", "add")(p, q))
+    assert got == [REF.g2_add(a, b) for a, b in zip(p_v, q_v)]
+    got_dbl = _unpack("g2", _jitted("g2", "double")(p))
+    assert got_dbl == [REF.g2_double(a) for a in p_v]
+
+
+def test_g1_scalar_mul_batched():
+    pts = rand_g1(3)
+    ks = [rng.randrange(R) for _ in pts] + [0]
+    pts = pts + [pts[0]]
+    p = C.g1_pack(limb.FP, pts)
+    s = C.fr_pack(limb.FR, ks)
+    got = _unpack("g1", _jitted("g1", "smul")(p, s))
+    assert got == [REF.g1_mul(pt, k) for pt, k in zip(pts, ks)]
+
+
+def test_g2_scalar_mul_batched():
+    pts = rand_g2(2)
+    ks = [rng.randrange(R) for _ in pts]
+    p = C.g2_pack(limb.FP, pts)
+    s = C.fr_pack(limb.FR, ks)
+    got = _unpack("g2", _jitted("g2", "smul")(p, s))
+    assert got == [REF.g2_mul(pt, k) for pt, k in zip(pts, ks)]
+
+
+def test_point_sum_axis():
+    # (2 groups, 3 terms) reduce over last axis
+    groups = [rand_g1(3), rand_g1(2) + [None]]
+    flat = [pt for g in groups for pt in g]
+    p = C.g1_pack(limb.FP, flat)
+    p = jax.tree_util.tree_map(lambda a: a.reshape(2, 3, -1), p)
+    got = _unpack("g1", _jitted("g1", "sum")(p))
+    want = []
+    for g in groups:
+        acc = None
+        for pt in g:
+            acc = REF.g1_add(acc, pt)
+        want.append(acc)
+    assert got == want

@@ -1,0 +1,128 @@
+"""Batched Fp2/Fp6/Fp12 tower vs the pure-Python oracle (crypto/fields.py).
+
+All device ops are jit-wrapped: eager execution would re-trace the scan-based
+mont ops on every call, which is orders of magnitude slower than the compiled
+path the framework actually uses.
+"""
+
+import functools
+import random
+
+import jax
+import numpy as np
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.ops import fptower as T
+from charon_tpu.ops import limb
+
+rng = random.Random(99)
+
+
+@functools.lru_cache(maxsize=None)
+def jop(name, ctx_name):
+    ctx = {"fp": limb.FP, "fp32": limb.FP32}[ctx_name]
+    return jax.jit(functools.partial(getattr(T, name), ctx))
+
+
+def rand_fp2(n):
+    return [(rng.randrange(F.P), rng.randrange(F.P)) for _ in range(n)]
+
+
+def rand_fp12(n):
+    return [
+        tuple(
+            tuple((rng.randrange(F.P), rng.randrange(F.P)) for _ in range(3))
+            for _ in range(2)
+        )
+        for _ in range(n)
+    ]
+
+
+def test_fp2_ops_match_oracle():
+    ctx = limb.FP
+    a_v, b_v = rand_fp2(8), rand_fp2(8)
+    a, b = T.fp2_pack(ctx, a_v), T.fp2_pack(ctx, b_v)
+    assert T.fp2_unpack(ctx, jop("fp2_mul", "fp")(a, b)) == [
+        F.fp2_mul(x, y) for x, y in zip(a_v, b_v)
+    ]
+    assert T.fp2_unpack(ctx, jop("fp2_sqr", "fp")(a)) == [
+        F.fp2_sqr(x) for x in a_v
+    ]
+    assert T.fp2_unpack(ctx, jop("fp2_add", "fp")(a, b)) == [
+        F.fp2_add(x, y) for x, y in zip(a_v, b_v)
+    ]
+    assert T.fp2_unpack(ctx, jop("fp2_mul_xi", "fp")(a)) == [
+        F._mul_by_xi(x) for x in a_v
+    ]
+    assert T.fp2_unpack(ctx, jop("fp2_inv", "fp")(a)) == [
+        F.fp2_inv(x) for x in a_v
+    ]
+    small12 = jax.jit(functools.partial(T.fp2_small, ctx, k=12))
+    assert T.fp2_unpack(ctx, small12(a)) == [F.fp2_scalar(x, 12) for x in a_v]
+
+
+def test_fp12_mul_sqr_frobenius_match_oracle():
+    ctx = limb.FP
+    a_v, b_v = rand_fp12(4), rand_fp12(4)
+    a, b = T.fp12_pack(ctx, a_v), T.fp12_pack(ctx, b_v)
+    assert T.fp12_unpack(ctx, jop("fp12_mul", "fp")(a, b)) == [
+        F.fp12_mul(x, y) for x, y in zip(a_v, b_v)
+    ]
+    assert T.fp12_unpack(ctx, jop("fp12_sqr", "fp")(a)) == [
+        F.fp12_sqr(x) for x in a_v
+    ]
+    assert T.fp12_unpack(ctx, jop("fp12_frobenius", "fp")(a)) == [
+        F.fp12_frobenius(x) for x in a_v
+    ]
+
+
+def test_fp12_inv_matches_oracle():
+    ctx = limb.FP
+    a_v = rand_fp12(2)
+    a = T.fp12_pack(ctx, a_v)
+    assert T.fp12_unpack(ctx, jop("fp12_inv", "fp")(a)) == [
+        F.fp12_inv(x) for x in a_v
+    ]
+
+
+def _unitary_cyclotomic(vals):
+    """Map random Fp12 elements into the cyclotomic subgroup the same way the
+    final exponentiation's easy part does: m = frob2(u) * u, u = conj(a)/a."""
+    out = []
+    for a in vals:
+        u = F.fp12_mul(F.fp12_conj(a), F.fp12_inv(a))
+        out.append(F.fp12_mul(F.fp12_frobenius_n(u, 2), u))
+    return out
+
+
+def test_cyclotomic_sqr_matches_generic():
+    ctx = limb.FP
+    m_v = _unitary_cyclotomic(rand_fp12(3))
+    m = T.fp12_pack(ctx, m_v)
+    got = T.fp12_unpack(ctx, jop("fp12_cyclotomic_sqr", "fp")(m))
+    assert got == [F.fp12_sqr(x) for x in m_v]
+
+
+def test_fp12_is_one_mask():
+    ctx = limb.FP
+    vals = rand_fp12(2)
+    ones = [
+        ((F.FP2_ONE, F.FP2_ZERO, F.FP2_ZERO), F.FP6_ZERO),
+    ]
+    a = T.fp12_pack(ctx, vals + ones)
+    mask = np.asarray(jop("fp12_is_one", "fp")(a))
+    assert list(mask) == [False, False, True]
+
+
+def test_tower_on_u32_geometry():
+    ctx = limb.FP32
+    a_v, b_v = rand_fp2(4), rand_fp2(4)
+    a, b = T.fp2_pack(ctx, a_v), T.fp2_pack(ctx, b_v)
+    assert T.fp2_unpack(ctx, jop("fp2_mul", "fp32")(a, b)) == [
+        F.fp2_mul(x, y) for x, y in zip(a_v, b_v)
+    ]
+    m_v = rand_fp12(2)
+    m = T.fp12_pack(ctx, m_v)
+    assert T.fp12_unpack(ctx, jop("fp12_sqr", "fp32")(m)) == [
+        F.fp12_sqr(x) for x in m_v
+    ]

@@ -16,17 +16,38 @@ def rand_elems(n, mod):
 
 
 def to_dev(ctx, vals):
-    return limb.to_mont(ctx, jnp.asarray(limb.pack(vals, ctx.n_limbs)))
+    return limb.to_mont(ctx, jnp.asarray(limb.ctx_pack(ctx, vals)))
 
 
 def from_dev(ctx, arr):
-    return limb.unpack(limb.from_mont(ctx, arr))
+    return limb.ctx_unpack(ctx, limb.from_mont(ctx, arr))
 
 
 def test_pack_unpack_roundtrip():
     vals = rand_elems(7, P)
     arr = limb.pack(vals, limb.FP.n_limbs)
     assert limb.unpack(arr) == vals
+
+
+def test_u32_geometry_matches_bigint():
+    """The TPU-friendly 12-bit/uint32 contexts agree with bigint math."""
+    for ctx, mod in ((limb.FP32, P), (limb.FR32, R)):
+        a_v = [0, 1, mod - 1] + rand_elems(13, mod)
+        b_v = [mod - 1, 0, mod - 2] + rand_elems(13, mod)
+        a, b = to_dev(ctx, a_v), to_dev(ctx, b_v)
+        assert from_dev(ctx, a) == a_v
+        assert np.asarray(a).dtype == np.uint32
+        assert from_dev(ctx, limb.mont_mul(ctx, a, b)) == [
+            x * y % mod for x, y in zip(a_v, b_v)
+        ]
+        assert from_dev(ctx, limb.add_mod(ctx, a, b)) == [
+            (x + y) % mod for x, y in zip(a_v, b_v)
+        ]
+        assert from_dev(ctx, limb.sub_mod(ctx, a, b)) == [
+            (x - y) % mod for x, y in zip(a_v, b_v)
+        ]
+        host = limb.pack_mont_host(ctx, a_v)
+        assert np.array_equal(np.asarray(a), host)
 
 
 def test_mont_roundtrip_and_domain():
