@@ -1,0 +1,109 @@
+"""Headline benchmark: batched BLS12-381 signature verification throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric matches BASELINE.json ("batched BLS verify sigs/sec"): the hot path
+the reference executes one herumi C++ call at a time
+(ref: core/validatorapi/validatorapi.go:1213 partial-sig verify,
+core/parsigex/parsigex.go:94-98 peer-sig verify). Here a whole batch runs
+as one XLA program on the accelerator.
+
+vs_baseline: measured device throughput divided by the single-threaded
+herumi-class CPU reference rate from BASELINE.md (the reference publishes
+no numbers — BASELINE.json.published == {} — so we use the well-known
+~1.5 ms/verify herumi envelope as the denominator; see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+# Single-signature BLS verify on a modern CPU core with herumi/BLST-class
+# C++ (the reference's backend): ~1.5 ms => ~666 sigs/sec.
+CPU_REFERENCE_SIGS_PER_SEC = 666.0
+
+BATCH = 256
+WARMUP = 1
+ITERS = 3
+
+
+def main() -> None:
+    import jax
+
+    from charon_tpu.crypto import bls, h2c
+    from charon_tpu.ops import curve as C
+    from charon_tpu.ops import limb
+    from charon_tpu.ops import pairing as DP
+
+    ctx = limb.default_fp_ctx()
+    fr_ctx = limb.default_fr_ctx()
+
+    # Build a verify workload entirely from public material. Signatures are
+    # generated on-device (dogfooding the batched scalar-mul kernel) to
+    # keep host bigint work out of the setup path.
+    import random
+
+    rng = random.Random(2026)
+    from charon_tpu.crypto.fields import R
+    from charon_tpu.ops import blsops
+
+    engine = blsops.BlsEngine(ctx, fr_ctx)
+    n_msgs = 8
+    msg_pts = [h2c.hash_to_g2(b"bench-%d" % i) for i in range(n_msgs)]
+    sks = [rng.randrange(1, R) for _ in range(BATCH)]
+    from charon_tpu.crypto.g1g2 import G1_GEN
+
+    pks = engine.g1_scalar_mul_batch([G1_GEN] * BATCH, sks)
+    msgs = [msg_pts[i % n_msgs] for i in range(BATCH)]
+    sigs = engine.g2_scalar_mul_batch(msgs, sks)
+
+    pk = C.g1_pack(ctx, pks)
+    msg = C.g2_pack(ctx, msgs)
+    sig = C.g2_pack(ctx, sigs)
+
+    kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
+
+    for _ in range(WARMUP):
+        ok = kernel(pk, msg, sig)
+        ok.block_until_ready()
+    assert bool(ok.all()), "bench workload failed verification"
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        kernel(pk, msg, sig).block_until_ready()
+        times.append(time.perf_counter() - t0)
+
+    best = min(times)
+    sigs_per_sec = BATCH / best
+    print(
+        json.dumps(
+            {
+                "metric": "batched_bls_verify",
+                "value": round(sigs_per_sec, 2),
+                "unit": "sigs/sec",
+                "vs_baseline": round(sigs_per_sec / CPU_REFERENCE_SIGS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit one parseable line
+        print(
+            json.dumps(
+                {
+                    "metric": "batched_bls_verify",
+                    "value": 0.0,
+                    "unit": "sigs/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+        )
+        sys.exit(0)

@@ -1,0 +1,29 @@
+"""Core duty workflow: the business logic of the distributed validator.
+
+Mirrors the reference's core layer (ref: core/interfaces.go — ten
+components stitched by core.Wire) re-designed for asyncio + batch-first
+crypto: immutable frozen-dataclass values flow through async pub/sub
+subscriptions, and every signature-heavy step hands whole duty-sets to the
+batched tbls backend instead of per-signature calls.
+
+Components (ref SURVEY.md §2.1):
+  types/eth2data  abstract value types (Duty, UnsignedData, SignedData)
+  deadline        duty-expiry engine
+  scheduler       slot ticker + duty resolution
+  fetcher         duty input data from the beacon node
+  consensus       pluggable consensus (QBFT)
+  dutydb          blocking unsigned-data store
+  validatorapi    beacon-API server for the downstream VC
+  parsigdb        partial-signature store w/ threshold grouping
+  parsigex        partial-signature exchange between peers
+  sigagg          batched threshold aggregation
+  aggsigdb        aggregated-signature store
+  bcast           broadcast to the beacon node
+  tracker         per-duty failure analysis
+"""
+
+from charon_tpu.core.types import (  # noqa: F401
+    Duty,
+    DutyType,
+    PubKey,
+)

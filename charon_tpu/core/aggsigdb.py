@@ -1,0 +1,52 @@
+"""AggSigDB: store of aggregated (group) signatures for later queries.
+
+Mirrors ref: core/aggsigdb/memory_v2.go (the simpler mutex design behind
+the AggSigDBV2 feature flag) — randao reveals are awaited by the proposal
+fetcher, selection proofs by the aggregator fetcher. Blocking awaits via
+keyed futures, trimmed by the Deadliner.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+
+from charon_tpu.core.eth2data import SignedData
+from charon_tpu.core.types import Duty, PubKey
+
+
+class AggSigDB:
+    def __init__(self) -> None:
+        self._values: dict[tuple[Duty, PubKey], SignedData] = {}
+        self._waiters: dict[tuple[Duty, PubKey], list[asyncio.Future]] = (
+            defaultdict(list)
+        )
+
+    async def store(self, duty: Duty, pubkey: PubKey, data: SignedData) -> None:
+        key = (duty, pubkey)
+        prev = self._values.get(key)
+        if prev is not None:
+            if prev.signature != data.signature:
+                raise ValueError(f"conflicting aggregate for {key}")
+            return
+        self._values[key] = data
+        for fut in self._waiters.pop(key, []):
+            if not fut.done():
+                fut.set_result(data)
+
+    async def store_set(self, duty: Duty, data_set: dict[PubKey, SignedData]) -> None:
+        for pubkey, data in data_set.items():
+            await self.store(duty, pubkey, data)
+
+    async def await_(self, duty: Duty, pubkey: PubKey) -> SignedData:
+        key = (duty, pubkey)
+        if key in self._values:
+            return self._values[key]
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters[key].append(fut)
+        return await fut
+
+    def trim(self, expired: Duty) -> None:
+        self._values = {
+            k: v for k, v in self._values.items() if k[0] != expired
+        }

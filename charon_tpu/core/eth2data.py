@@ -1,0 +1,312 @@
+"""Eth2 duty data objects: unsigned inputs and signed outputs.
+
+Mirrors the reference's UnsignedData / SignedData / Eth2SignedData value
+taxonomy (ref: core/types.go:52-91, core/eth2signeddata.go,
+core/unsigneddata.go, core/signeddata.go) with frozen dataclasses and
+spec-exact SSZ roots (charon_tpu/eth2util/ssz.py).
+
+Every signed object knows its signing domain and object root, so partial
+signatures can be verified against pubshares at the API boundary
+(ref: core/validatorapi/validatorapi.go:1213) and recovered group
+signatures against the group key (ref: core/sigagg/sigagg.go:117) through
+one generic path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import ClassVar
+
+from charon_tpu.eth2util import ssz
+from charon_tpu.eth2util.signing import DomainName, ForkInfo
+
+# ---------------------------------------------------------------------------
+# Spec containers (subset needed by the duty workflow)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Checkpoint:
+    epoch: int
+    root: bytes  # 32
+
+    ssz_fields: ClassVar = (ssz.UINT64, ssz.BYTES32)
+
+
+@dataclass(frozen=True)
+class AttestationData:
+    slot: int
+    index: int
+    beacon_block_root: bytes
+    source: Checkpoint
+    target: Checkpoint
+
+    ssz_fields: ClassVar = (
+        ssz.UINT64,
+        ssz.UINT64,
+        ssz.BYTES32,
+        ssz.Nested(),
+        ssz.Nested(),
+    )
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+@dataclass(frozen=True)
+class Attestation:
+    aggregation_bits: tuple[bool, ...]
+    data: AttestationData
+    signature: bytes = bytes(96)
+
+    ssz_fields: ClassVar = (
+        ssz.Bitlist(2048),
+        ssz.Nested(),
+        ssz.BYTES96,
+    )
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+@dataclass(frozen=True)
+class BeaconBlockHeader:
+    slot: int
+    proposer_index: int
+    parent_root: bytes
+    state_root: bytes
+    body_root: bytes
+
+    ssz_fields: ClassVar = (
+        ssz.UINT64,
+        ssz.UINT64,
+        ssz.BYTES32,
+        ssz.BYTES32,
+        ssz.BYTES32,
+    )
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+@dataclass(frozen=True)
+class Proposal:
+    """A block proposal: the spec header (whose root is signed) plus the
+    opaque full/blinded body payload the beacon node gave us, round-tripped
+    back on submission (the reference carries whole VersionedProposal
+    objects, ref: core/unsigneddata.go VersionedProposal; the workflow only
+    ever needs the root and the bytes)."""
+
+    header: BeaconBlockHeader
+    body: bytes = b""
+    blinded: bool = False
+
+    def hash_tree_root(self) -> bytes:
+        return self.header.hash_tree_root()
+
+
+@dataclass(frozen=True)
+class AggregateAndProof:
+    aggregator_index: int
+    aggregate: Attestation
+    selection_proof: bytes = bytes(96)
+
+    ssz_fields: ClassVar = (ssz.UINT64, ssz.Nested(), ssz.BYTES96)
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+@dataclass(frozen=True)
+class SyncCommitteeMessage:
+    slot: int
+    beacon_block_root: bytes
+    validator_index: int
+    signature: bytes = bytes(96)
+
+    # Signing root is over the block root only (spec: sync committee
+    # messages sign the beacon block root).
+
+
+@dataclass(frozen=True)
+class SyncCommitteeContribution:
+    slot: int
+    beacon_block_root: bytes
+    subcommittee_index: int
+    aggregation_bits: tuple[bool, ...] = ()
+    signature: bytes = bytes(96)
+
+    ssz_fields: ClassVar = (
+        ssz.UINT64,
+        ssz.BYTES32,
+        ssz.UINT64,
+        ssz.Bitvector(128),
+        ssz.BYTES96,
+    )
+
+    def hash_tree_root(self) -> bytes:
+        bits = self.aggregation_bits or tuple([False] * 128)
+        tmp = replace(self, aggregation_bits=bits)
+        return ssz.hash_tree_root(tmp)
+
+
+@dataclass(frozen=True)
+class ContributionAndProof:
+    aggregator_index: int
+    contribution: SyncCommitteeContribution
+    selection_proof: bytes = bytes(96)
+
+    ssz_fields: ClassVar = (ssz.UINT64, ssz.Nested(), ssz.BYTES96)
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+@dataclass(frozen=True)
+class ValidatorRegistration:
+    fee_recipient: bytes  # 20
+    gas_limit: int
+    timestamp: int
+    pubkey: bytes  # 48
+
+    ssz_fields: ClassVar = (
+        ssz.ByteVector(20),
+        ssz.UINT64,
+        ssz.UINT64,
+        ssz.BYTES48,
+    )
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+@dataclass(frozen=True)
+class VoluntaryExit:
+    epoch: int
+    validator_index: int
+
+    ssz_fields: ClassVar = (ssz.UINT64, ssz.UINT64)
+
+    def hash_tree_root(self) -> bytes:
+        return ssz.hash_tree_root(self)
+
+
+# ---------------------------------------------------------------------------
+# Unsigned duty data (consensus payloads)
+# ---------------------------------------------------------------------------
+
+# UnsignedData is duck-typed: any frozen value with hash_tree_root().
+# Per-duty unsigned payloads (ref: core/unsigneddata.go):
+#   ATTESTER          -> AttestationDuty (att data + committee info)
+#   PROPOSER          -> Proposal
+#   AGGREGATOR        -> Attestation (the aggregate to sign over)
+#   SYNC_CONTRIBUTION -> SyncCommitteeContribution
+
+
+@dataclass(frozen=True)
+class AttestationDuty:
+    """Consensus payload for an attester duty: the agreed attestation data
+    plus the validator's committee coordinates (the reference keeps these
+    in its AttestationData wrapper, ref: core/unsigneddata.go:60-100)."""
+
+    data: AttestationData
+    committee_length: int
+    committee_index: int  # position of the validator in the committee
+    validator_committee_index: int
+
+    def hash_tree_root(self) -> bytes:
+        return self.data.hash_tree_root()
+
+
+# ---------------------------------------------------------------------------
+# Signed data: a generic envelope with a domain registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SignedData:
+    """A signable duty output: payload + BLS signature.
+
+    kind selects the signing domain and how the object root is derived
+    (ref: core/eth2signeddata.go implements one Go type per kind; here one
+    envelope + a registry keeps the wire/db layers fully generic)."""
+
+    kind: str
+    payload: object
+    signature: bytes = b""
+
+    def with_signature(self, sig: bytes) -> "SignedData":
+        return replace(self, signature=sig)
+
+    def signing_root(self, fork: ForkInfo, slot_epoch: int) -> bytes:
+        spec = SIGNED_KINDS[self.kind]
+        return fork.signing_root(spec.domain, spec.object_root(self.payload))
+
+
+@dataclass(frozen=True)
+class KindSpec:
+    domain: DomainName
+    object_root: object  # Callable[[payload], bytes]
+
+
+def _epoch_root(epoch: int) -> bytes:
+    return ssz.UINT64.hash_tree_root(epoch)
+
+
+def _slot_root(slot: int) -> bytes:
+    return ssz.UINT64.hash_tree_root(slot)
+
+
+SIGNED_KINDS: dict[str, KindSpec] = {
+    "attestation": KindSpec(
+        DomainName.BEACON_ATTESTER, lambda att: att.data.hash_tree_root()
+    ),
+    "block": KindSpec(
+        DomainName.BEACON_PROPOSER, lambda p: p.hash_tree_root()
+    ),
+    "randao": KindSpec(DomainName.RANDAO, _epoch_root),
+    "selection_proof": KindSpec(DomainName.SELECTION_PROOF, _slot_root),
+    "aggregate_and_proof": KindSpec(
+        DomainName.AGGREGATE_AND_PROOF, lambda a: a.hash_tree_root()
+    ),
+    "sync_message": KindSpec(
+        DomainName.SYNC_COMMITTEE, lambda m: m.beacon_block_root
+    ),
+    "sync_selection": KindSpec(
+        DomainName.SYNC_COMMITTEE_SELECTION_PROOF,
+        lambda d: ssz.Container((ssz.UINT64, ssz.UINT64)).hash_tree_root(
+            (d.slot, d.subcommittee_index)
+        ),
+    ),
+    "contribution_and_proof": KindSpec(
+        DomainName.CONTRIBUTION_AND_PROOF, lambda c: c.hash_tree_root()
+    ),
+    "registration": KindSpec(
+        DomainName.APPLICATION_BUILDER, lambda r: r.hash_tree_root()
+    ),
+    "exit": KindSpec(
+        DomainName.VOLUNTARY_EXIT, lambda e: e.hash_tree_root()
+    ),
+}
+
+
+@dataclass(frozen=True)
+class SyncSelectionData:
+    slot: int
+    subcommittee_index: int
+
+
+@dataclass(frozen=True)
+class ParSignedData:
+    """A partially signed duty output carrying its share index
+    (ref: core/types.go ParSignedData)."""
+
+    data: SignedData
+    share_idx: int
+
+    def message_root(self) -> bytes:
+        """Root identifying *what* was signed — partials for the same duty
+        group by this before threshold recombination
+        (ref: core/parsigdb/memory.go:198 groups by message root)."""
+        spec = SIGNED_KINDS[self.data.kind]
+        return spec.object_root(self.data.payload)

@@ -1,0 +1,110 @@
+"""ParSigDB: partial-signature store with threshold grouping.
+
+Mirrors ref: core/parsigdb/memory.go — keyed by (duty, pubkey): internal
+stores fan out to the exchange component, incoming shares are deduped by
+share index with conflict detection (memory.go:145-177), grouped by message
+root, and exactly when the t-th matching signature arrives the batch is
+emitted to the threshold subscribers (memory.go:198-225).
+
+Batch-first addition: the store emits *duty-level* threshold batches — all
+pubkeys of a duty that crossed the threshold in this store call are
+delivered together, so sigagg can recombine them in one device program.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Awaitable, Callable
+
+from charon_tpu.core.eth2data import ParSignedData
+from charon_tpu.core.types import Duty, PubKey
+
+
+class SigConflictError(Exception):
+    """Same share index submitted two different signatures for one duty —
+    byzantine behaviour worth surfacing (ref: memory.go conflict errors)."""
+
+
+InternalSub = Callable[[Duty, dict[PubKey, ParSignedData]], Awaitable[None]]
+ThresholdSub = Callable[
+    [Duty, dict[PubKey, list[ParSignedData]]], Awaitable[None]
+]
+
+
+class ParSigDB:
+    def __init__(self, threshold: int) -> None:
+        self.threshold = threshold
+        # (duty, pubkey) -> share_idx -> ParSignedData
+        self._store: dict[tuple[Duty, PubKey], dict[int, ParSignedData]] = (
+            defaultdict(dict)
+        )
+        self._emitted: set[tuple[Duty, PubKey]] = set()
+        self._internal_subs: list[InternalSub] = []
+        self._threshold_subs: list[ThresholdSub] = []
+
+    def subscribe_internal(self, sub: InternalSub) -> None:
+        self._internal_subs.append(sub)
+
+    def subscribe_threshold(self, sub: ThresholdSub) -> None:
+        self._threshold_subs.append(sub)
+
+    # -- stores -----------------------------------------------------------
+
+    async def store_internal(
+        self, duty: Duty, signed_set: dict[PubKey, ParSignedData]
+    ) -> None:
+        """Store our own partial signatures and fan them out to the peers
+        via the subscribed exchange (ref: memory.go:57-77)."""
+        for sub in self._internal_subs:
+            await sub(duty, signed_set)
+        await self.store_external(duty, signed_set)
+
+    async def store_external(
+        self, duty: Duty, signed_set: dict[PubKey, ParSignedData]
+    ) -> None:
+        """Store peer (or local) partials; emit one duty-level batch for
+        every pubkey that reached the threshold in this call."""
+        ready: dict[PubKey, list[ParSignedData]] = {}
+        for pubkey, psig in signed_set.items():
+            batch = self._put(duty, pubkey, psig)
+            if batch is not None:
+                ready[pubkey] = batch
+        if ready:
+            for sub in self._threshold_subs:
+                await sub(duty, ready)
+
+    def _put(
+        self, duty: Duty, pubkey: PubKey, psig: ParSignedData
+    ) -> list[ParSignedData] | None:
+        key = (duty, pubkey)
+        sigs = self._store[key]
+        prev = sigs.get(psig.share_idx)
+        if prev is not None:
+            if prev.data.signature != psig.data.signature:
+                raise SigConflictError(
+                    f"share {psig.share_idx} equivocated for {duty}/{pubkey}"
+                )
+            return None  # duplicate
+        sigs[psig.share_idx] = psig
+
+        if key in self._emitted:
+            return None
+        # Group by message root; emit exactly when some root hits t
+        # (ref: memory.go:198-225 emits when len == threshold).
+        by_root: dict[bytes, list[ParSignedData]] = defaultdict(list)
+        for s in sigs.values():
+            by_root[s.message_root()].append(s)
+        batch = by_root.get(psig.message_root())
+        if batch is not None and len(batch) == self.threshold:
+            self._emitted.add(key)
+            return sorted(batch, key=lambda s: s.share_idx)
+        return None
+
+    # -- trimming ---------------------------------------------------------
+
+    def trim(self, expired: Duty) -> None:
+        self._store = defaultdict(
+            dict,
+            {k: v for k, v in self._store.items() if k[0] != expired},
+        )
+        self._emitted = {k for k in self._emitted if k[0] != expired}

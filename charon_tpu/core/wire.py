@@ -1,0 +1,64 @@
+"""wire(): stitch the core workflow components into the duty pipeline.
+
+Mirrors ref: core/interfaces.go:282-357 core.Wire — a pure subscription
+graph with optional wrapping (tracing, tracking, async-retry) applied to
+every edge. Components are duck-typed; any may be replaced by a test fake
+(the reference proves this pattern with its simnet, ref: app/app.go:862).
+
+Subscription graph (ref: core/interfaces.go:336-356):
+
+    scheduler --duties--> fetcher --proposals--> consensus --decided--> dutydb
+    validatorapi --partials--> parsigdb --internal--> parsigex --> peers
+    parsigdb --threshold--> sigagg --> aggsigdb
+                                  \\--> broadcaster
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Sequence
+
+WireOption = Callable[[str, Callable], Callable]
+
+
+def wire(
+    *,
+    scheduler,
+    fetcher,
+    consensus,
+    dutydb,
+    validatorapi,
+    parsigdb,
+    parsigex,
+    sigagg,
+    aggsigdb,
+    broadcaster,
+    options: Sequence[WireOption] = (),
+) -> None:
+    def wrap(name: str, fn: Callable) -> Callable:
+        for opt in options:
+            fn = opt(name, fn)
+        return fn
+
+    scheduler.subscribe_duties(wrap("fetcher.fetch", fetcher.fetch))
+    fetcher.register_consensus(wrap("consensus.propose", consensus.propose))
+    fetcher.register_agg_sig_db(wrap("aggsigdb.await", aggsigdb.await_))
+    fetcher.register_await_attestation(
+        wrap("dutydb.await_attestation", dutydb.await_attestation)
+    )
+    consensus.subscribe(wrap("dutydb.store", dutydb.store))
+    validatorapi.register_await_attestation(dutydb.await_attestation)
+    validatorapi.register_await_proposal(dutydb.await_proposal)
+    validatorapi.register_await_aggregated_attestation(
+        dutydb.await_aggregated_attestation
+    )
+    validatorapi.register_await_sync_contribution(
+        dutydb.await_sync_contribution
+    )
+    validatorapi.register_pubkey_by_attestation(dutydb.pubkey_by_attestation)
+    validatorapi.register_get_duty_definition(scheduler.get_duty_definition)
+    validatorapi.subscribe(wrap("parsigdb.store_internal", parsigdb.store_internal))
+    parsigdb.subscribe_internal(wrap("parsigex.broadcast", parsigex.broadcast))
+    parsigex.subscribe(wrap("parsigdb.store_external", parsigdb.store_external))
+    parsigdb.subscribe_threshold(wrap("sigagg.aggregate", sigagg.aggregate))
+    sigagg.subscribe(wrap("aggsigdb.store", aggsigdb.store_set))
+    sigagg.subscribe(wrap("broadcaster.broadcast", broadcaster.broadcast))

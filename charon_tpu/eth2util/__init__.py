@@ -1,0 +1,6 @@
+"""Eth2 utilities: SSZ hashing, signing domains, networks, keystores.
+
+Mirrors the reference's eth2util layer (ref: eth2util/ — signing domains,
+EIP-2335 keystores, deposit data, ENR helpers) in Python, built on a small
+spec-exact SSZ merkleization core instead of the reference's codegen.
+"""

@@ -1,0 +1,196 @@
+"""Minimal spec-exact SSZ hash-tree-root (simple serialize subset).
+
+Implements the SSZ merkleization the duty workflow needs — uint64, byte
+vectors, fixed containers, lists with limits, bitlists — exactly per the
+eth2 simple-serialize spec, so signing roots computed here match any
+compliant client. (The reference gets this via go-eth2-client types and a
+codegen helper, ref: app/genssz; we implement the spec directly.)
+
+Only hash_tree_root (+ its serialization helpers) is provided: the
+framework's wire formats are protobuf/JSON, and SSZ is used for roots.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, fields
+from typing import Any, Sequence
+
+_ZERO_CHUNK = bytes(32)
+
+
+def _sha(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+def merkleize(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
+    """Merkleize 32-byte chunks, padded with zero chunks to the limit (or
+    to the next power of two when no limit is given)."""
+    count = len(chunks)
+    size = _next_pow2(limit if limit is not None else max(count, 1))
+    if limit is not None and count > limit:
+        raise ValueError("chunk count exceeds limit")
+    # Precompute zero-subtree hashes up the levels.
+    layer = list(chunks) if chunks else [_ZERO_CHUNK]
+    zero = _ZERO_CHUNK
+    width = size
+    while width > 1:
+        if len(layer) % 2:
+            layer.append(zero)
+        layer = [_sha(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+        zero = _sha(zero, zero)
+        width //= 2
+    return layer[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return _sha(root, length.to_bytes(32, "little"))
+
+
+def pack_bytes(data: bytes) -> list[bytes]:
+    """Right-pad bytes into 32-byte chunks."""
+    if not data:
+        return []
+    padded = data + bytes((-len(data)) % 32)
+    return [padded[i : i + 32] for i in range(0, len(padded), 32)]
+
+
+# -- type descriptors --------------------------------------------------------
+
+
+class SSZType:
+    def hash_tree_root(self, value) -> bytes:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Uint64(SSZType):
+    def hash_tree_root(self, value: int) -> bytes:
+        return int(value).to_bytes(8, "little") + bytes(24)
+
+
+@dataclass(frozen=True)
+class Boolean(SSZType):
+    def hash_tree_root(self, value: bool) -> bytes:
+        return bytes([1 if value else 0]) + bytes(31)
+
+
+@dataclass(frozen=True)
+class ByteVector(SSZType):
+    length: int
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"expected {self.length} bytes, got {len(value)}")
+        return merkleize(pack_bytes(value))
+
+
+@dataclass(frozen=True)
+class ByteList(SSZType):
+    limit: int
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("byte list exceeds limit")
+        chunk_limit = (self.limit + 31) // 32
+        return mix_in_length(
+            merkleize(pack_bytes(value), chunk_limit), len(value)
+        )
+
+
+@dataclass(frozen=True)
+class Vector(SSZType):
+    elem: SSZType
+    length: int
+
+    def hash_tree_root(self, value: Sequence) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("vector length mismatch")
+        return merkleize([self.elem.hash_tree_root(v) for v in value])
+
+
+@dataclass(frozen=True)
+class List(SSZType):
+    elem: SSZType
+    limit: int
+
+    def hash_tree_root(self, value: Sequence) -> bytes:
+        if isinstance(self.elem, Uint64):
+            # basic-type lists pack values into chunks
+            data = b"".join(int(v).to_bytes(8, "little") for v in value)
+            chunk_limit = (self.limit * 8 + 31) // 32
+            root = merkleize(pack_bytes(data), chunk_limit)
+        else:
+            root = merkleize(
+                [self.elem.hash_tree_root(v) for v in value], self.limit
+            )
+        return mix_in_length(root, len(value))
+
+
+@dataclass(frozen=True)
+class Bitlist(SSZType):
+    limit: int
+
+    def hash_tree_root(self, value: Sequence[bool]) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("bitlist exceeds limit")
+        data = bytearray((len(value) + 7) // 8)
+        for i, bit in enumerate(value):
+            if bit:
+                data[i // 8] |= 1 << (i % 8)
+        chunk_limit = (self.limit + 255) // 256
+        return mix_in_length(
+            merkleize(pack_bytes(bytes(data)), chunk_limit), len(value)
+        )
+
+
+@dataclass(frozen=True)
+class Bitvector(SSZType):
+    length: int
+
+    def hash_tree_root(self, value: Sequence[bool]) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("bitvector length mismatch")
+        data = bytearray((self.length + 7) // 8)
+        for i, bit in enumerate(value):
+            if bit:
+                data[i // 8] |= 1 << (i % 8)
+        return merkleize(pack_bytes(bytes(data)))
+
+
+@dataclass(frozen=True)
+class Nested(SSZType):
+    """Field whose value is itself an ssz_fields-bearing dataclass."""
+
+    def hash_tree_root(self, value) -> bytes:
+        return hash_tree_root(value)
+
+
+@dataclass(frozen=True)
+class Container(SSZType):
+    field_types: tuple[SSZType, ...]
+
+    def hash_tree_root(self, value: Sequence) -> bytes:
+        if len(value) != len(self.field_types):
+            raise ValueError("container field count mismatch")
+        return merkleize(
+            [t.hash_tree_root(v) for t, v in zip(self.field_types, value)]
+        )
+
+
+def hash_tree_root(obj: Any) -> bytes:
+    """Root of an object whose dataclass declares `ssz_fields`: a tuple of
+    SSZType descriptors aligned with its dataclass fields."""
+    types = obj.ssz_fields
+    values = [getattr(obj, f.name) for f in fields(obj)][: len(types)]
+    return Container(tuple(types)).hash_tree_root(values)
+
+
+BYTES32 = ByteVector(32)
+BYTES48 = ByteVector(48)
+BYTES96 = ByteVector(96)
+UINT64 = Uint64()

@@ -1,0 +1,340 @@
+"""User-facing batched BLS kernels: verify, threshold-aggregate, aggregate.
+
+This is the device engine behind the tbls TPU implementation. Where the
+reference recombines one signature at a time on the CPU
+(ref: tbls/herumi.go:249-286 ThresholdAggregate — Lagrange interpolation at
+the share indices; ref: tbls/herumi.go:288 Verify — one pairing per call),
+these kernels take whole [num_validators, threshold] / [num_sigs] batches
+and execute them as single XLA programs.
+
+Kernel-shape discipline: public entry points pad the batch axis to the next
+power of two and cache one compiled program per (kernel, padded-shape,
+threshold) key, so steady-state slot processing never recompiles.
+
+Identity encoding: affine (0, 0) lanes are group identities throughout
+(safe on these curves since b != 0 means y = 0 never occurs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import fptower as T
+from charon_tpu.ops import limb
+from charon_tpu.ops import pairing as DP
+from charon_tpu.ops.limb import ModCtx
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# Device Lagrange coefficients at zero (Fr)
+# ---------------------------------------------------------------------------
+
+
+def _indices_to_fr(fr_ctx: ModCtx, idx):
+    """int32 share indices (..., ) -> raw Fr limb arrays.
+
+    Supports indices up to 2^(2*limb_bits) (far beyond any cluster size)."""
+    idx = idx.astype(jnp.uint32)
+    lo = (idx & np.uint32(fr_ctx.mask)).astype(fr_ctx.dtype)
+    hi = (idx >> np.uint32(fr_ctx.limb_bits)).astype(fr_ctx.dtype)
+    out = limb.zeros(fr_ctx, idx.shape)
+    out = out.at[..., 0].set(lo)
+    out = out.at[..., 1].set(hi)
+    return out
+
+
+def lagrange_coeffs_at_zero(fr_ctx: ModCtx, idx, t: int):
+    """Batched Lagrange basis at x=0: idx is (..., t) int32 of distinct
+    nonzero share indices; returns raw Fr limbs (..., t, n_limbs).
+
+        coeff_j = prod_{m != j} x_m / (x_m - x_j)   (mod r)
+
+    (spec: charon_tpu/crypto/shamir.py:45). t is static and small, so the
+    j/m loops unroll; the inversions are one vectorized Fermat chain.
+    """
+    x_mont = limb.to_mont(fr_ctx, _indices_to_fr(fr_ctx, idx))  # (..., t, L)
+    xs = [x_mont[..., j, :] for j in range(t)]
+    nums, dens = [], []
+    for j in range(t):
+        num = None
+        den = None
+        for m in range(t):
+            if m == j:
+                continue
+            num = xs[m] if num is None else limb.mont_mul(fr_ctx, num, xs[m])
+            d = limb.sub_mod(fr_ctx, xs[m], xs[j])
+            den = d if den is None else limb.mont_mul(fr_ctx, den, d)
+        if num is None:  # t == 1
+            num = limb.const(fr_ctx, 1, xs[j].shape[:-1])
+            den = limb.const(fr_ctx, 1, xs[j].shape[:-1])
+        nums.append(num)
+        dens.append(den)
+    num = jnp.stack(nums, axis=-2)  # (..., t, L)
+    den = jnp.stack(dens, axis=-2)
+    coeff = limb.mont_mul(fr_ctx, num, limb.inv_mod(fr_ctx, den))
+    return limb.from_mont(fr_ctx, coeff)  # raw, for the bit schedule
+
+
+# ---------------------------------------------------------------------------
+# Raw (already-packed) kernels — jit-compiled once per padded shape
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _threshold_agg_kernel(ctx: ModCtx, fr_ctx: ModCtx, t: int):
+    f = C.g2_ops(ctx)
+
+    def kernel(sig_affine, idx):
+        # sig_affine: affine G2 with batch shape (V, t); idx: (V, t) int32
+        coeffs = lagrange_coeffs_at_zero(fr_ctx, idx, t)  # (V, t, L)
+        proj = C.affine_to_point(f, sig_affine)
+        scaled = C.point_scalar_mul(f, fr_ctx, proj, coeffs)
+        total = C.point_sum(f, scaled, axis=-1)  # reduce the t axis
+        return C.point_to_affine(f, total)
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_kernel(ctx: ModCtx):
+    return jax.jit(functools.partial(DP.batched_verify, ctx))
+
+
+@functools.lru_cache(maxsize=None)
+def _aggregate_kernel(ctx: ModCtx, k: int):
+    """Sum k G2 points per lane (signature aggregation)."""
+    f = C.g2_ops(ctx)
+
+    def kernel(sig_affine):
+        proj = C.affine_to_point(f, sig_affine)
+        return C.point_to_affine(f, C.point_sum(f, proj, axis=-1))
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _g1_sum_kernel(ctx: ModCtx, k: int):
+    f = C.g1_ops(ctx)
+
+    def kernel(pk_affine):
+        proj = C.affine_to_point(f, pk_affine)
+        return C.point_to_affine(f, C.point_sum(f, proj, axis=-1))
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _subgroup_g2_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    f = C.g2_ops(ctx)
+
+    def kernel(pts, order):
+        proj = C.affine_to_point(f, pts)
+        rp = C.point_scalar_mul(f, fr_ctx, proj, order)
+        return C.point_is_identity(f, rp)
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _subgroup_g1_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    f = C.g1_ops(ctx)
+
+    def kernel(pts, order):
+        proj = C.affine_to_point(f, pts)
+        rp = C.point_scalar_mul(f, fr_ctx, proj, order)
+        return C.point_is_identity(f, rp)
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _g1_scalar_mul_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    f = C.g1_ops(ctx)
+
+    def kernel(base_affine, scalars):
+        proj = C.affine_to_point(f, base_affine)
+        return C.point_to_affine(f, C.point_scalar_mul(f, fr_ctx, proj, scalars))
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _g2_scalar_mul_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    f = C.g2_ops(ctx)
+
+    def kernel(base_affine, scalars):
+        proj = C.affine_to_point(f, base_affine)
+        return C.point_to_affine(f, C.point_scalar_mul(f, fr_ctx, proj, scalars))
+
+    return jax.jit(kernel)
+
+
+# ---------------------------------------------------------------------------
+# Host-facing batched operations (Python-int points in, results out)
+# ---------------------------------------------------------------------------
+
+
+class BlsEngine:
+    """Batched BLS12-381 engine bound to a limb geometry.
+
+    Host boundary: affine Python-int points in/out (the facade handles
+    compressed-bytes conversion and caching). Every method pads its batch
+    to a power of two so compiled kernels are reused across calls.
+    """
+
+    def __init__(self, ctx: ModCtx | None = None, fr_ctx: ModCtx | None = None):
+        self.ctx = ctx or limb.default_fp_ctx()
+        self.fr_ctx = fr_ctx or limb.default_fr_ctx()
+
+    # -- verification -----------------------------------------------------
+
+    def verify_batch(self, pks, msg_points, sigs) -> list[bool]:
+        """Lane-wise: e(pk_i, H(m)_i) == e(G1, sig_i).
+
+        pks: affine G1 (or None); msg_points: affine G2 hashed messages;
+        sigs: affine G2 (or None). Identity-lane semantics are the caller's
+        concern (the facade rejects infinite pubkeys up front).
+        """
+        n = len(pks)
+        pad = _next_pow2(n)
+        pk = C.g1_pack(self.ctx, list(pks) + [None] * (pad - n))
+        msg = C.g2_pack(self.ctx, list(msg_points) + [None] * (pad - n))
+        sig = C.g2_pack(self.ctx, list(sigs) + [None] * (pad - n))
+        ok = _verify_kernel(self.ctx)(pk, msg, sig)
+        return [bool(b) for b in np.asarray(ok)[:n]]
+
+    # -- threshold recombination -----------------------------------------
+
+    def threshold_aggregate_batch(self, partials: list[dict]) -> list:
+        """Each entry maps share index -> affine G2 partial signature; all
+        entries must share the same threshold t = len(dict). Returns the
+        recombined affine G2 group signature per entry
+        (spec: crypto/shamir.py:68; ref: tbls/herumi.go:249)."""
+        if not partials:
+            return []
+        t = len(partials[0])
+        if any(len(p) != t for p in partials):
+            raise ValueError("all entries must have the same threshold")
+        v = len(partials)
+        pad = _next_pow2(v)
+        idx = np.ones((pad, t), np.int32)
+        idx[:, :] = np.arange(1, t + 1, dtype=np.int32)  # benign pad rows
+        flat_sigs = []
+        for row, p in enumerate(partials):
+            items = sorted(p.items())
+            idx[row] = [i for i, _ in items]
+            flat_sigs.extend(s for _, s in items)
+        flat_sigs.extend([None] * ((pad - v) * t))
+        sig = C.g2_pack(self.ctx, flat_sigs)
+        sig = jax.tree_util.tree_map(
+            lambda a: a.reshape(pad, t, *a.shape[1:]), sig
+        )
+        out = _threshold_agg_kernel(self.ctx, self.fr_ctx, t)(
+            sig, jnp.asarray(idx)
+        )
+        return C.g2_unpack(self.ctx, out)[:v]
+
+    # -- plain aggregation (point addition) ------------------------------
+
+    def aggregate_sigs_batch(self, groups: list[list]) -> list:
+        """Sum each group of affine G2 signatures (ref: tbls/herumi.go:225
+        Aggregate). Groups are padded to a common length with identities."""
+        if not groups:
+            return []
+        k = max(len(g) for g in groups)
+        v = len(groups)
+        pad = _next_pow2(v)
+        flat = []
+        for g in groups:
+            flat.extend(g)
+            flat.extend([None] * (k - len(g)))
+        flat.extend([None] * ((pad - v) * k))
+        sig = C.g2_pack(self.ctx, flat)
+        sig = jax.tree_util.tree_map(
+            lambda a: a.reshape(pad, k, *a.shape[1:]), sig
+        )
+        out = _aggregate_kernel(self.ctx, k)(sig)
+        return C.g2_unpack(self.ctx, out)[:v]
+
+    def aggregate_pks_batch(self, groups: list[list]) -> list:
+        """Sum each group of affine G1 pubkeys (FastAggregateVerify input)."""
+        if not groups:
+            return []
+        k = max(len(g) for g in groups)
+        v = len(groups)
+        pad = _next_pow2(v)
+        flat = []
+        for g in groups:
+            flat.extend(g)
+            flat.extend([None] * (k - len(g)))
+        flat.extend([None] * ((pad - v) * k))
+        pk = C.g1_pack(self.ctx, flat)
+        pk = jax.tree_util.tree_map(
+            lambda a: a.reshape(pad, k, *a.shape[1:]), pk
+        )
+        out = _g1_sum_kernel(self.ctx, k)(pk)
+        return C.g1_unpack(self.ctx, out)[:v]
+
+    # -- subgroup membership ---------------------------------------------
+
+    def subgroup_check_g2_batch(self, points) -> list[bool]:
+        """[r]P == identity for decompressed (on-curve) G2 points — the
+        prime-order subgroup check eth2 mandates before pairing. None lanes
+        (identities) pass. Batched 255-bit ladder, one device call."""
+        n = len(points)
+        if n == 0:
+            return []
+        pad = _next_pow2(n)
+        pts = C.g2_pack(self.ctx, list(points) + [None] * (pad - n))
+        # Raw (unreduced!) group order as the ladder schedule.
+        order = jnp.asarray(
+            limb.ctx_pack(self.fr_ctx, [self.fr_ctx.modulus] * pad)
+        )
+        mask = _subgroup_g2_kernel(self.ctx, self.fr_ctx)(pts, order)
+        return [bool(b) for b in np.asarray(mask)[:n]]
+
+    def subgroup_check_g1_batch(self, points) -> list[bool]:
+        n = len(points)
+        if n == 0:
+            return []
+        pad = _next_pow2(n)
+        pts = C.g1_pack(self.ctx, list(points) + [None] * (pad - n))
+        order = jnp.asarray(
+            limb.ctx_pack(self.fr_ctx, [self.fr_ctx.modulus] * pad)
+        )
+        mask = _subgroup_g1_kernel(self.ctx, self.fr_ctx)(pts, order)
+        return [bool(b) for b in np.asarray(mask)[:n]]
+
+    # -- scalar multiplication (DKG / key derivation) --------------------
+
+    def g1_scalar_mul_batch(self, bases, scalars: list[int]) -> list:
+        """[k_i] P_i over G1 — the DKG verification workhorse
+        (ref: dkg/frost.go public-share checks)."""
+        n = len(bases)
+        pad = _next_pow2(n)
+        base = C.g1_pack(self.ctx, list(bases) + [None] * (pad - n))
+        s = C.fr_pack(self.fr_ctx, list(scalars) + [0] * (pad - n))
+        out = _g1_scalar_mul_kernel(self.ctx, self.fr_ctx)(base, s)
+        return C.g1_unpack(self.ctx, out)[:n]
+
+    def g2_scalar_mul_batch(self, bases, scalars: list[int]) -> list:
+        n = len(bases)
+        pad = _next_pow2(n)
+        base = C.g2_pack(self.ctx, list(bases) + [None] * (pad - n))
+        s = C.fr_pack(self.fr_ctx, list(scalars) + [0] * (pad - n))
+        out = _g2_scalar_mul_kernel(self.ctx, self.fr_ctx)(base, s)
+        return C.g2_unpack(self.ctx, out)[:n]
+
+
+@functools.lru_cache(maxsize=None)
+def default_engine() -> BlsEngine:
+    return BlsEngine()

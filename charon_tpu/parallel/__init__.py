@@ -1,0 +1,15 @@
+"""Device-mesh sharding of the crypto batch plane.
+
+The reference scales across validators by keeping duty sets cluster-level
+(ref: docs/architecture.md:131-133 — one DutyDefinitionSet per slot for all
+DVs) and across share indices with t-of-n recombination. Here those two
+axes become array batch dimensions, and this package shards them over a
+`jax.sharding.Mesh` with shard_map — batch-parallel over ICI within a
+slice, DCN across hosts, with psum reductions for the cluster-wide
+all-valid flags.
+"""
+
+from charon_tpu.parallel.mesh import (  # noqa: F401
+    SlotCryptoPlane,
+    make_mesh,
+)

@@ -1,0 +1,138 @@
+"""Sharded slot-level crypto step over a device mesh.
+
+Design (SURVEY.md §2.4, §7): the two parallelism axes of the reference —
+validator-set batching (axis №1) and share-index t-of-n recombination
+(axis №2) — map to array dimensions [V, t]. V is sharded over the mesh's
+'shards' axis with shard_map; t stays local (the Lagrange reduction is a
+t-term point fold). The only cross-device communication is a psum of the
+per-shard validity counts — kilobyte-scale, riding ICI.
+
+This is the "training step" analogue of the framework: one call per slot
+processes every validator's partial signatures — verify each against its
+pubshare, recombine to group signatures, verify the group signature — as a
+single compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from charon_tpu.ops import blsops
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import fptower as T
+from charon_tpu.ops import limb
+from charon_tpu.ops import pairing as DP
+from charon_tpu.ops.limb import ModCtx
+
+
+def make_mesh(devices=None, axis: str = "shards") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+class SlotCryptoPlane:
+    """The per-slot batched crypto program, sharded over a mesh.
+
+    Inputs per slot (leading axis V = #validators, sharded):
+      pubshares  [V, t]  affine G1 — per-share public keys
+      msg        [V]     affine G2 — per-validator signing roots (hashed)
+      partials   [V, t]  affine G2 — per-share partial signatures
+      group_pk   [V]     affine G1 — group public keys
+      indices    [V, t]  int32     — share indices (1-based)
+
+    Outputs:
+      group_sig  [V]  affine G2 — recombined signatures (sharded)
+      sig_ok     [V]  bool      — per-partial verify AND group verify
+      total_ok   []   int32     — cluster-wide count of fully-valid lanes
+                                  (psum over shards)
+    """
+
+    def __init__(self, mesh: Mesh, t: int, ctx: ModCtx | None = None, fr_ctx: ModCtx | None = None):
+        self.mesh = mesh
+        self.t = t
+        self.ctx = ctx or limb.default_fp_ctx()
+        self.fr_ctx = fr_ctx or limb.default_fr_ctx()
+        self.axis = mesh.axis_names[0]
+        self._step = self._build()
+
+    def _build(self):
+        ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
+        g2f = C.g2_ops(ctx)
+
+        def local_step(pubshares, msg, partials, group_pk, indices):
+            # [Vl, t] partial verifies: flatten share axis into the batch.
+            flat = jax.tree_util.tree_map(
+                lambda a: a.reshape(-1, *a.shape[2:]), (pubshares, partials)
+            )
+            msg_rep = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, t, axis=0), msg
+            )
+            part_ok = DP.batched_verify(ctx, flat[0], msg_rep, flat[1])
+            part_ok = part_ok.reshape(-1, t)
+
+            # Threshold recombination [Vl].
+            coeffs = blsops.lagrange_coeffs_at_zero(fr_ctx, indices, t)
+            proj = C.affine_to_point(g2f, partials)
+            scaled = C.point_scalar_mul(g2f, fr_ctx, proj, coeffs)
+            group_sig = C.point_to_affine(
+                g2f, C.point_sum(g2f, scaled, axis=-1)
+            )
+
+            # Group verify [Vl].
+            group_ok = DP.batched_verify(ctx, group_pk, msg, group_sig)
+
+            ok = jnp.logical_and(jnp.all(part_ok, axis=-1), group_ok)
+            total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis)
+            return group_sig, ok, total
+
+        sharded = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P()),
+        )
+        return jax.jit(sharded)
+
+    # -- host-facing ------------------------------------------------------
+
+    def shard_count(self) -> int:
+        return self.mesh.devices.size
+
+    def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):
+        """Python-int affine points -> device arrays laid out [V, t]/[V].
+
+        V must be divisible by the mesh size (callers pad with identity
+        lanes; identity lanes verify as False and are sliced off).
+        """
+        v = len(msgs)
+        t = self.t
+        flat_ps = [p for row in pubshares for p in row]
+        flat_sig = [s for row in partials for s in row]
+        ps = C.g1_pack(self.ctx, flat_ps)
+        ps = jax.tree_util.tree_map(lambda a: a.reshape(v, t, -1), ps)
+        sig = C.g2_pack(self.ctx, flat_sig)
+        sig = jax.tree_util.tree_map(lambda a: a.reshape(v, t, -1), sig)
+        msg = C.g2_pack(self.ctx, msgs)
+        gpk = C.g1_pack(self.ctx, group_pks)
+        idx = jnp.asarray(np.asarray(indices, np.int32))
+        return ps, msg, sig, gpk, idx
+
+    def step(self, pubshares, msg, partials, group_pk, indices):
+        """Run one slot step on packed inputs. Returns (group_sig, ok,
+        total_ok) device values."""
+        return self._step(pubshares, msg, partials, group_pk, indices)
+
+    def step_host(self, pubshares, msgs, partials, group_pks, indices):
+        """Convenience host-level wrapper (pack, run, unpack)."""
+        args = self.pack_inputs(pubshares, msgs, partials, group_pks, indices)
+        group_sig, ok, total = self._step(*args)
+        return (
+            C.g2_unpack(self.ctx, group_sig),
+            [bool(b) for b in np.asarray(ok)],
+            int(total),
+        )

@@ -1,0 +1,181 @@
+"""TPU tbls backend: batched JAX kernels behind the Implementation API.
+
+Where the reference binds herumi's C++ one-call-per-signature backend
+(ref: tbls/herumi.go), this backend routes every operation through the
+batched device engine (charon_tpu/ops/blsops.py). Single-item calls are
+batches of one; the core workflow uses the *_batch entry points to push
+whole duty-sets through one compiled XLA program per slot.
+
+Host/device split (SURVEY.md §7 design stance):
+  * secret material (keygen, Shamir split/recover, signing) stays on the
+    host — the device only ever sees public points;
+  * hash-to-curve (SHA-256 expand + SSWU) runs on the host, cached;
+  * pairings, Lagrange recombination, point sums, and subgroup checks run
+    batched on the device.
+
+Caching: decompressed pubkeys are cached by compressed bytes (cluster
+pubshares are a small static set — ref: core/validatorapi pubshare maps),
+as are hashed messages.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping, Sequence
+
+from charon_tpu.crypto import g1g2, h2c
+from charon_tpu.crypto.fields import R
+from charon_tpu.ops import blsops
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import limb
+from charon_tpu.tbls import Implementation, TblsError
+from charon_tpu.tbls.python_impl import PythonImpl, sig_to_point
+
+
+@functools.lru_cache(maxsize=65536)
+def _cached_pubkey_point(pubkey: bytes):
+    """Decompress + subgroup-check a pubkey once; amortized across slots."""
+    try:
+        pt = g1g2.g1_from_bytes(pubkey, subgroup_check=True)
+    except ValueError as e:
+        raise TblsError(str(e)) from e
+    if pt is None:
+        raise TblsError("infinite public key")
+    return pt
+
+
+@functools.lru_cache(maxsize=16384)
+def _cached_msg_point(data: bytes):
+    return h2c.hash_to_g2(data)
+
+
+class TPUImpl(Implementation):
+    """Batched device implementation.
+
+    verify_inputs: when True (default), signature points are
+    subgroup-checked on device before use. The core workflow's aggregation
+    path sets False because every partial signature it aggregates was
+    already individually verified on arrival (ref: core/parsigex
+    verification before store).
+    """
+
+    def __init__(self, engine: blsops.BlsEngine | None = None, verify_inputs: bool = True):
+        self.engine = engine or blsops.default_engine()
+        self.verify_inputs = verify_inputs
+        self._host = PythonImpl()
+
+    # -- host-side secret ops (delegate to the Python backend) ------------
+
+    def generate_secret_key(self) -> bytes:
+        return self._host.generate_secret_key()
+
+    def secret_to_public_key(self, secret: bytes) -> bytes:
+        return self._host.secret_to_public_key(secret)
+
+    def threshold_split(self, secret: bytes, total: int, threshold: int):
+        return self._host.threshold_split(secret, total, threshold)
+
+    def recover_secret(self, shares, total: int, threshold: int) -> bytes:
+        return self._host.recover_secret(shares, total, threshold)
+
+    def sign(self, secret: bytes, data: bytes) -> bytes:
+        return self._host.sign(secret, data)
+
+    # -- decompression helpers -------------------------------------------
+
+    def _sig_points(self, sigs: Sequence[bytes], what: str) -> list:
+        """Decompress signatures on host (no subgroup check — that runs
+        batched on device when verify_inputs is set)."""
+        pts = []
+        for sig in sigs:
+            pt = sig_to_point(sig, subgroup_check=False)
+            if pt is None:
+                raise TblsError(f"infinite {what}")
+            pts.append(pt)
+        if self.verify_inputs:
+            ok = self.engine.subgroup_check_g2_batch(pts)
+            if not all(ok):
+                raise TblsError(f"{what} not in G2 subgroup")
+        return pts
+
+    # -- verification -----------------------------------------------------
+
+    def verify(self, pubkey: bytes, data: bytes, sig: bytes) -> None:
+        if not self.verify_batch([(pubkey, data, sig)])[0]:
+            raise TblsError("signature verification failed")
+
+    def verify_batch(self, items) -> list[bool]:
+        if not items:
+            return []
+        n = len(items)
+        pks: list = [None] * n
+        msgs: list = [None] * n
+        sigs: list = [None] * n
+        ok = [True] * n
+        for i, (pk, data, sig) in enumerate(items):
+            try:
+                pks[i] = _cached_pubkey_point(pk)
+                msgs[i] = _cached_msg_point(data)
+                sigs[i] = sig_to_point(sig, subgroup_check=False)
+                if sigs[i] is None:
+                    raise TblsError("infinite signature")
+            except TblsError:
+                ok[i] = False
+                pks[i] = msgs[i] = sigs[i] = None
+        verified = self.engine.verify_batch(pks, msgs, sigs)
+        if self.verify_inputs:
+            in_subgroup = self.engine.subgroup_check_g2_batch(sigs)
+        else:
+            in_subgroup = [True] * n
+        return [o and v and s for o, v, s in zip(ok, verified, in_subgroup)]
+
+    def verify_aggregate(self, pubkeys: Sequence[bytes], data: bytes, sig: bytes) -> None:
+        if not pubkeys:
+            raise TblsError("no public keys")
+        pts = [_cached_pubkey_point(pk) for pk in pubkeys]
+        [agg_pk] = self.engine.aggregate_pks_batch([pts])
+        if agg_pk is None:
+            raise TblsError("aggregate public key is infinite")
+        [sig_pt] = self._sig_points([sig], "signature")
+        [ok] = self.engine.verify_batch(
+            [agg_pk], [_cached_msg_point(data)], [sig_pt]
+        )
+        if not ok:
+            raise TblsError("aggregate signature verification failed")
+
+    # -- aggregation ------------------------------------------------------
+
+    def threshold_aggregate(self, partials: Mapping[int, bytes]) -> bytes:
+        return self.threshold_aggregate_batch([partials])[0]
+
+    def threshold_aggregate_batch(self, batch) -> list[bytes]:
+        if not batch:
+            return []
+        point_batch = []
+        for partials in batch:
+            if not partials:
+                raise TblsError("no partial signatures")
+            if any(i <= 0 for i in partials):
+                raise TblsError("share indices are 1-based")
+            flat = list(partials.items())
+            pts = self._sig_points([s for _, s in flat], "partial signature")
+            point_batch.append({i: pt for (i, _), pt in zip(flat, pts)})
+        t = len(point_batch[0])
+        if any(len(p) != t for p in point_batch):
+            raise TblsError("inconsistent thresholds in batch")
+        out = self.engine.threshold_aggregate_batch(point_batch)
+        return [g1g2.g2_to_bytes(pt) for pt in out]
+
+    def aggregate(self, sigs: Sequence[bytes]) -> bytes:
+        return self.aggregate_batch([sigs])[0]
+
+    def aggregate_batch(self, groups) -> list[bytes]:
+        if not groups:
+            return []
+        point_groups = []
+        for sigs in groups:
+            if not sigs:
+                raise TblsError("no signatures")
+            point_groups.append(self._sig_points(sigs, "signature"))
+        out = self.engine.aggregate_sigs_batch(point_groups)
+        return [g1g2.g2_to_bytes(pt) for pt in out]

@@ -1,0 +1,289 @@
+"""Core workflow components: ssz roots, stores, deadliner, sigagg."""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.core import deadline as dl
+from charon_tpu.core import eth2data as d
+from charon_tpu.core.aggsigdb import AggSigDB
+from charon_tpu.core.dutydb import ConflictError, DutyDB
+from charon_tpu.core.parsigdb import ParSigDB, SigConflictError
+from charon_tpu.core.sigagg import AggregationError, SigAgg
+from charon_tpu.core.types import Duty, DutyType, PubKey, pubkey_from_bytes
+from charon_tpu.eth2util import signing, ssz
+from charon_tpu.tbls.python_impl import PythonImpl
+
+FORK = signing.ForkInfo(
+    genesis_validators_root=b"\x01" * 32,
+    fork_version=b"\x00\x00\x00\x01",
+    genesis_fork_version=b"\x00\x00\x00\x00",
+)
+
+
+def _att_data(slot=5, index=2):
+    return d.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=b"\xaa" * 32,
+        source=d.Checkpoint(0, b"\xbb" * 32),
+        target=d.Checkpoint(1, b"\xcc" * 32),
+    )
+
+
+# -- ssz ---------------------------------------------------------------------
+
+
+def test_ssz_uint64_and_container_roots():
+    # Known-good: hash_tree_root(Checkpoint) = sha256(epoch_le32 || root)
+    cp = d.Checkpoint(epoch=3, root=b"\xcc" * 32)
+    want = hashlib.sha256(
+        (3).to_bytes(8, "little") + bytes(24) + b"\xcc" * 32
+    ).digest()
+    assert ssz.hash_tree_root(cp) == want
+
+
+def test_ssz_attestation_data_root_depends_on_fields():
+    r1 = _att_data().hash_tree_root()
+    assert r1 == _att_data().hash_tree_root()
+    assert r1 != _att_data(slot=6).hash_tree_root()
+    assert len(r1) == 32
+
+
+def test_signing_root_domain_separation():
+    root = _att_data().hash_tree_root()
+    r_att = FORK.signing_root(signing.DomainName.BEACON_ATTESTER, root)
+    r_prop = FORK.signing_root(signing.DomainName.BEACON_PROPOSER, root)
+    assert r_att != r_prop
+
+
+def test_bitlist_root_differs_by_length():
+    bl = ssz.Bitlist(8)
+    assert bl.hash_tree_root([True]) != bl.hash_tree_root([True, False])
+
+
+# -- dutydb ------------------------------------------------------------------
+
+
+PK = pubkey_from_bytes(bytes(47) + b"\x01")
+
+
+def _att_duty():
+    return d.AttestationDuty(
+        data=_att_data(),
+        committee_length=4,
+        committee_index=1,
+        validator_committee_index=2,
+    )
+
+
+def test_dutydb_blocking_await_and_pubkey_by_attestation():
+    async def run():
+        db = DutyDB()
+        duty = Duty(5, DutyType.ATTESTER)
+        task = asyncio.create_task(db.await_attestation(5, PK))
+        await asyncio.sleep(0.01)
+        assert not task.done()
+        await db.store(duty, {PK: _att_duty()})
+        got = await asyncio.wait_for(task, 1)
+        assert got.data.slot == 5
+        root = got.data.hash_tree_root()
+        assert db.pubkey_by_attestation(5, root) == PK
+        assert db.pubkey_by_attestation(5, b"\x00" * 32) is None
+
+    asyncio.run(run())
+
+
+def test_dutydb_conflict_detection():
+    async def run():
+        db = DutyDB()
+        duty = Duty(5, DutyType.ATTESTER)
+        await db.store(duty, {PK: _att_duty()})
+        await db.store(duty, {PK: _att_duty()})  # idempotent ok
+        other = d.AttestationDuty(
+            data=_att_data(index=9),
+            committee_length=4,
+            committee_index=1,
+            validator_committee_index=2,
+        )
+        with pytest.raises(ConflictError):
+            await db.store(duty, {PK: other})
+
+    asyncio.run(run())
+
+
+# -- parsigdb ----------------------------------------------------------------
+
+
+def _psig(share_idx: int, sig: bytes = b"", root_seed: int = 0):
+    att = d.Attestation(
+        aggregation_bits=(True,), data=_att_data(index=root_seed)
+    )
+    return d.ParSignedData(
+        data=d.SignedData("attestation", att, sig or bytes([share_idx]) * 96),
+        share_idx=share_idx,
+    )
+
+
+def test_parsigdb_threshold_emission():
+    async def run():
+        db = ParSigDB(threshold=3)
+        got = []
+
+        async def on_threshold(duty, ready):
+            got.append((duty, ready))
+
+        db.subscribe_threshold(on_threshold)
+        duty = Duty(5, DutyType.ATTESTER)
+        await db.store_external(duty, {PK: _psig(1)})
+        await db.store_external(duty, {PK: _psig(2)})
+        assert got == []
+        await db.store_external(duty, {PK: _psig(3)})
+        assert len(got) == 1
+        _, ready = got[0]
+        assert [p.share_idx for p in ready[PK]] == [1, 2, 3]
+        # 4th sig after emission: no re-emission
+        await db.store_external(duty, {PK: _psig(4)})
+        assert len(got) == 1
+
+    asyncio.run(run())
+
+
+def test_parsigdb_groups_by_message_root():
+    async def run():
+        db = ParSigDB(threshold=2)
+        got = []
+
+        async def on_threshold(duty, ready):
+            got.append(ready)
+
+        db.subscribe_threshold(on_threshold)
+        duty = Duty(5, DutyType.ATTESTER)
+        await db.store_external(duty, {PK: _psig(1, root_seed=0)})
+        await db.store_external(duty, {PK: _psig(2, root_seed=1)})  # other root
+        assert got == []
+        await db.store_external(duty, {PK: _psig(3, root_seed=0)})
+        assert len(got) == 1
+        assert [p.share_idx for p in got[0][PK]] == [1, 3]
+
+    asyncio.run(run())
+
+
+def test_parsigdb_equivocation_detection():
+    async def run():
+        db = ParSigDB(threshold=3)
+        duty = Duty(5, DutyType.ATTESTER)
+        await db.store_external(duty, {PK: _psig(1, sig=b"\x01" * 96)})
+        with pytest.raises(SigConflictError):
+            await db.store_external(duty, {PK: _psig(1, sig=b"\x02" * 96)})
+
+    asyncio.run(run())
+
+
+def test_parsigdb_internal_fans_out():
+    async def run():
+        db = ParSigDB(threshold=2)
+        sent = []
+
+        async def exchange(duty, signed_set):
+            sent.append(signed_set)
+
+        db.subscribe_internal(exchange)
+        await db.store_internal(Duty(5, DutyType.ATTESTER), {PK: _psig(1)})
+        assert len(sent) == 1
+
+    asyncio.run(run())
+
+
+# -- deadliner ---------------------------------------------------------------
+
+
+def test_deadliner_expires_and_drops_stale():
+    async def run():
+        clock = dl.SlotClock(genesis_time=0.0, slot_duration=1.0)
+        now = [100.0]
+        expired = []
+
+        dead = dl.Deadliner(
+            clock, lambda duty: expired.append(duty), now=lambda: now[0]
+        )
+        # slot 99 + max(5*1s, 30s) window = 129 > 100: accepted
+        assert dead.add(Duty(99, DutyType.ATTESTER))
+        # ancient duty: deadline 30+5 << 100
+        assert not dead.add(Duty(0, DutyType.ATTESTER))
+        dead.start()
+        now[0] = 130.0  # jump past the deadline
+        await asyncio.sleep(0.05)
+        await dead.stop()
+        assert expired == [Duty(99, DutyType.ATTESTER)]
+
+    asyncio.run(run())
+
+
+# -- sigagg (python tbls backend; the TPU path is covered in test_tbls) ------
+
+
+def test_sigagg_recombines_and_verifies():
+    async def run():
+        impl = PythonImpl()
+        tbls.set_implementation(impl)
+        secret = impl.generate_secret_key()
+        shares = impl.threshold_split(secret, 4, 3)
+        group_pk = impl.secret_to_public_key(secret)
+        pk = pubkey_from_bytes(group_pk)
+
+        duty = Duty(5, DutyType.ATTESTER)
+        att = d.Attestation(aggregation_bits=(True,), data=_att_data())
+        unsigned = d.SignedData("attestation", att)
+        root = unsigned.signing_root(FORK, duty.slot // 32)
+
+        psigs = [
+            d.ParSignedData(
+                data=unsigned.with_signature(impl.sign(shares[i], root)),
+                share_idx=i,
+            )
+            for i in (1, 2, 3)
+        ]
+
+        agg = SigAgg(threshold=3, fork=FORK)
+        out = []
+
+        async def on_agg(duty, data_set):
+            out.append(data_set)
+
+        agg.subscribe(on_agg)
+        await agg.aggregate(duty, {pk: psigs})
+        assert len(out) == 1
+        group_sig = out[0][pk].signature
+        impl.verify(group_pk, root, group_sig)
+
+        # corrupted partial -> recovered sig fails verification
+        bad = psigs[:2] + [
+            d.ParSignedData(
+                data=unsigned.with_signature(impl.sign(shares[4], b"wrong")),
+                share_idx=4,
+            )
+        ]
+        with pytest.raises(AggregationError):
+            await agg.aggregate(duty, {pk: bad})
+
+    asyncio.run(run())
+
+
+# -- aggsigdb ----------------------------------------------------------------
+
+
+def test_aggsigdb_store_await():
+    async def run():
+        db = AggSigDB()
+        duty = Duty(5, DutyType.RANDAO)
+        data = d.SignedData("randao", 0, b"\x05" * 96)
+        task = asyncio.create_task(db.await_(duty, PK))
+        await asyncio.sleep(0.01)
+        await db.store(duty, PK, data)
+        got = await asyncio.wait_for(task, 1)
+        assert got.signature == data.signature
+
+    asyncio.run(run())

@@ -1,0 +1,164 @@
+"""tbls facade: full threshold suite + randomized cross-implementation
+byte-compatibility (modelled on ref: tbls/tbls_test.go:209-237, which runs
+the whole suite against an impl that picks a random backend per call to
+prove the backends are interchangeable)."""
+
+import random
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.tbls.python_impl import PythonImpl
+from charon_tpu.tbls.tpu_impl import TPUImpl
+
+rng = random.Random(5)
+
+N, T = 4, 3
+MSG = b"test duty signing root"
+
+
+class RandomizedImpl(tbls.Implementation):
+    """Picks a random backend per call (ref: tbls/tbls_test.go:209)."""
+
+    def __init__(self, impls):
+        self.impls = impls
+
+    def _pick(self):
+        return rng.choice(self.impls)
+
+    def generate_secret_key(self):
+        return self._pick().generate_secret_key()
+
+    def secret_to_public_key(self, secret):
+        return self._pick().secret_to_public_key(secret)
+
+    def threshold_split(self, secret, total, threshold):
+        return self._pick().threshold_split(secret, total, threshold)
+
+    def recover_secret(self, shares, total, threshold):
+        return self._pick().recover_secret(shares, total, threshold)
+
+    def sign(self, secret, data):
+        return self._pick().sign(secret, data)
+
+    def verify(self, pubkey, data, sig):
+        return self._pick().verify(pubkey, data, sig)
+
+    def verify_aggregate(self, pubkeys, data, sig):
+        return self._pick().verify_aggregate(pubkeys, data, sig)
+
+    def threshold_aggregate(self, partials):
+        return self._pick().threshold_aggregate(partials)
+
+    def aggregate(self, sigs):
+        return self._pick().aggregate(sigs)
+
+
+@pytest.fixture(scope="module")
+def impls():
+    return [PythonImpl(), TPUImpl()]
+
+
+@pytest.fixture(scope="module")
+def cluster(impls):
+    py = impls[0]
+    secret = py.generate_secret_key()
+    shares = py.threshold_split(secret, N, T)
+    pubkey = py.secret_to_public_key(secret)
+    pubshares = {i: py.secret_to_public_key(s) for i, s in shares.items()}
+    partials = {i: py.sign(s, MSG) for i, s in shares.items()}
+    return dict(
+        secret=secret,
+        shares=shares,
+        pubkey=pubkey,
+        pubshares=pubshares,
+        partials=partials,
+    )
+
+
+def test_threshold_aggregate_cross_impl(impls, cluster):
+    subset = {i: cluster["partials"][i] for i in list(cluster["partials"])[:T]}
+    results = [impl.threshold_aggregate(subset) for impl in impls]
+    # byte-identical recombination across backends
+    assert results[0] == results[1]
+    for impl in impls:
+        impl.verify(cluster["pubkey"], MSG, results[0])
+
+
+def test_any_t_subset_recombines_to_same_signature(impls, cluster):
+    py, tpu = impls
+    import itertools
+
+    sigs = set()
+    for combo in itertools.combinations(cluster["partials"], T):
+        subset = {i: cluster["partials"][i] for i in combo}
+        sigs.add(tpu.threshold_aggregate(subset))
+    assert len(sigs) == 1
+    py.verify(cluster["pubkey"], MSG, next(iter(sigs)))
+
+
+def test_partial_verifies_against_pubshare(impls, cluster):
+    for impl in impls:
+        for i, sig in cluster["partials"].items():
+            impl.verify(cluster["pubshares"][i], MSG, sig)
+        with pytest.raises(tbls.TblsError):
+            impl.verify(cluster["pubshares"][1], MSG, cluster["partials"][2])
+
+
+def test_verify_rejects_bad_inputs(impls, cluster):
+    good = cluster["partials"][1]
+    for impl in impls:
+        with pytest.raises(tbls.TblsError):
+            impl.verify(cluster["pubkey"], MSG, good[:-1])  # truncated
+        with pytest.raises(tbls.TblsError):
+            impl.verify(cluster["pubkey"][:-1], MSG, good)
+        with pytest.raises(tbls.TblsError):
+            impl.verify(bytes(48), MSG, good)  # malformed pubkey
+
+
+def test_recover_secret(impls, cluster):
+    py = impls[0]
+    for impl in impls:
+        sub = {i: cluster["shares"][i] for i in list(cluster["shares"])[:T]}
+        rec = impl.recover_secret(sub, N, T)
+        assert py.secret_to_public_key(rec) == cluster["pubkey"]
+
+
+def test_aggregate_and_verify_aggregate(impls):
+    py, tpu = impls
+    sks = [py.generate_secret_key() for _ in range(3)]
+    pks = [py.secret_to_public_key(sk) for sk in sks]
+    msg = b"same message for all"
+    sigs = [py.sign(sk, msg) for sk in sks]
+    agg_py = py.aggregate(sigs)
+    agg_tpu = tpu.aggregate(sigs)
+    assert agg_py == agg_tpu
+    for impl in impls:
+        impl.verify_aggregate(pks, msg, agg_py)
+        with pytest.raises(tbls.TblsError):
+            impl.verify_aggregate(pks[:2], msg, agg_py)
+
+
+def test_tpu_verify_batch_mixed(impls, cluster):
+    tpu = impls[1]
+    items = [
+        (cluster["pubshares"][1], MSG, cluster["partials"][1]),
+        (cluster["pubshares"][2], MSG, cluster["partials"][1]),  # wrong share
+        (cluster["pubshares"][3], MSG, cluster["partials"][3]),
+        (cluster["pubkey"], MSG, cluster["partials"][1]),  # partial != group
+    ]
+    assert tpu.verify_batch(items) == [True, False, True, False]
+
+
+def test_randomized_impl_full_suite(impls, cluster):
+    tbls.set_implementation(RandomizedImpl(impls))
+    try:
+        subset = {i: cluster["partials"][i] for i in list(cluster["partials"])[:T]}
+        sig = tbls.threshold_aggregate(subset)
+        tbls.verify(cluster["pubkey"], MSG, sig)
+        sk = tbls.generate_secret_key()
+        pk = tbls.secret_to_public_key(sk)
+        s = tbls.sign(sk, b"hello")
+        tbls.verify(pk, b"hello", s)
+    finally:
+        tbls.set_implementation(impls[0])
