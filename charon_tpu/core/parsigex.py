@@ -1,0 +1,91 @@
+"""ParSigEx: partial-signature exchange between cluster peers.
+
+Mirrors ref: core/parsigex/parsigex.go — direct n² broadcast of every
+locally stored partial-signature set to all peers; incoming sets are
+verified against the sending share's pubshares *before* storing
+(parsigex.go:94-98). MemTransport is the in-process variant the simnet
+uses (ref: core/parsigex/memory.go); the TCP transport plugs into the same
+component via the p2p layer.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Awaitable, Callable
+
+from charon_tpu import tbls
+from charon_tpu.core.eth2data import ParSignedData
+from charon_tpu.core.types import Duty, PubKey
+from charon_tpu.eth2util.signing import ForkInfo
+
+ExSub = Callable[[Duty, dict[PubKey, ParSignedData]], Awaitable[None]]
+
+
+class Eth2Verifier:
+    """Verifies peer partial signatures against the sender's pubshares,
+    batched (ref: core/parsigex/parsigex.go:146-170 NewEth2Verifier)."""
+
+    def __init__(
+        self,
+        fork: ForkInfo,
+        pubshares_by_idx: dict[int, dict[PubKey, bytes]],
+        slots_per_epoch: int = 32,
+    ) -> None:
+        self.fork = fork
+        self.pubshares_by_idx = pubshares_by_idx
+        self.slots_per_epoch = slots_per_epoch
+
+    def verify(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> bool:
+        items = []
+        for pubkey, psig in signed_set.items():
+            shares = self.pubshares_by_idx.get(psig.share_idx)
+            if shares is None or pubkey not in shares:
+                return False
+            root = psig.data.signing_root(
+                self.fork, duty.slot // self.slots_per_epoch
+            )
+            items.append((shares[pubkey], root, psig.data.signature))
+        return all(tbls.verify_batch(items))
+
+
+class MemTransport:
+    """Loopback wiring of n ParSigEx components (in-process simnet)."""
+
+    def __init__(self) -> None:
+        self.nodes: list["ParSigEx"] = []
+
+    def attach(self, node: "ParSigEx") -> None:
+        self.nodes.append(node)
+
+    async def send(self, from_idx: int, duty: Duty, signed_set) -> None:
+        for node in self.nodes:
+            if node.share_idx != from_idx:
+                await node.receive(duty, signed_set)
+
+
+class ParSigEx:
+    def __init__(
+        self,
+        share_idx: int,
+        transport: MemTransport,
+        verifier: Eth2Verifier | None = None,
+    ) -> None:
+        self.share_idx = share_idx
+        self.transport = transport
+        self.verifier = verifier
+        self._subs: list[ExSub] = []
+        transport.attach(self)
+
+    def subscribe(self, sub: ExSub) -> None:
+        self._subs.append(sub)
+
+    async def broadcast(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> None:
+        """Send our partials to all peers (ref: parsigex.go:112)."""
+        await self.transport.send(self.share_idx, duty, signed_set)
+
+    async def receive(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> None:
+        """Peer partials arrive; verify then store (ref: parsigex.go:68-109)."""
+        if self.verifier is not None and not self.verifier.verify(duty, signed_set):
+            return  # drop invalid sets (logged/tracked in the full stack)
+        for sub in self._subs:
+            await sub(duty, signed_set)

@@ -1,0 +1,151 @@
+"""ValidatorAPI: the beacon-node facade served to the downstream VC.
+
+Mirrors ref: core/validatorapi/validatorapi.go — maps group pubkeys to this
+node's pubshares (validatorapi.go:1080,1167), serves duty data with
+blocking awaits against DutyDB, verifies every incoming partial signature
+against the node's pubshare (validatorapi.go:1213) and pushes it into
+ParSigDB as a ParSignedData.
+
+This module is the transport-agnostic component; the HTTP router
+(charon_tpu/core/vapi_http.py) exposes it as the eth2 beacon API the same
+way ref core/validatorapi/router.go does. Partial-signature verification
+is batched: one device call per submission set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Sequence
+
+from charon_tpu import tbls
+from charon_tpu.core.eth2data import (
+    Attestation,
+    AttestationDuty,
+    ParSignedData,
+    Proposal,
+    SignedData,
+)
+from charon_tpu.core.types import Duty, DutyType, PubKey, pubkey_to_bytes
+from charon_tpu.eth2util.signing import ForkInfo
+
+
+class VapiError(Exception):
+    pass
+
+
+@dataclass
+class ValidatorAPI:
+    """share_idx: this node's 1-based share index; pubshares maps group
+    pubkey -> this node's compressed pubshare bytes."""
+
+    share_idx: int
+    pubshares: dict[PubKey, bytes]
+    fork: ForkInfo
+    slots_per_epoch: int = 32
+
+    def __post_init__(self) -> None:
+        self._subs: list = []
+        self._await_attestation = None
+        self._await_proposal = None
+        self._await_agg_att = None
+        self._await_contrib = None
+        self._pubkey_by_att = None
+        self._duty_defs = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def subscribe(self, sub) -> None:
+        self._subs.append(sub)
+
+    def register_await_attestation(self, fn) -> None:
+        self._await_attestation = fn
+
+    def register_await_proposal(self, fn) -> None:
+        self._await_proposal = fn
+
+    def register_await_aggregated_attestation(self, fn) -> None:
+        self._await_agg_att = fn
+
+    def register_await_sync_contribution(self, fn) -> None:
+        self._await_contrib = fn
+
+    def register_pubkey_by_attestation(self, fn) -> None:
+        self._pubkey_by_att = fn
+
+    def register_get_duty_definition(self, fn) -> None:
+        self._duty_defs = fn
+
+    # -- queries (VC pulls duty data; blocking until consensus) ------------
+
+    async def attestation_data(self, slot: int, committee_index: int):
+        """GET /eth/v1/validator/attestation_data analogue
+        (ref: validatorapi.go:261 via dutydb.AwaitAttestation)."""
+        duty = Duty(slot, DutyType.ATTESTER)
+        defs = self._duty_defs(duty) if self._duty_defs else {}
+        for pubkey, d in defs.items():
+            if d.committee_index == committee_index:
+                att_duty = await self._await_attestation(slot, pubkey)
+                return att_duty.data
+        raise VapiError(f"no attester duty for slot {slot} committee {committee_index}")
+
+    async def proposal(self, slot: int, pubkey: PubKey) -> Proposal:
+        return await self._await_proposal(slot, pubkey)
+
+    # -- submissions (VC pushes partial signatures) ------------------------
+
+    async def submit_attestations(self, atts: Sequence[Attestation]) -> None:
+        """POST /eth/v1/beacon/pool/attestations analogue
+        (ref: validatorapi.go:274 SubmitAttestations)."""
+        by_duty: dict[Duty, dict[PubKey, ParSignedData]] = {}
+        items = []
+        metas = []
+        for att in atts:
+            slot = att.data.slot
+            root = att.data.hash_tree_root()
+            pubkey = self._pubkey_by_att(slot, root)
+            if pubkey is None:
+                raise VapiError("unknown attestation (no DutyDB entry)")
+            signed = SignedData("attestation", att, att.signature)
+            items.append(self._verify_item(pubkey, signed, slot))
+            metas.append((Duty(slot, DutyType.ATTESTER), pubkey, signed))
+        self._check_batch(items)
+        for duty, pubkey, signed in metas:
+            by_duty.setdefault(duty, {})[pubkey] = ParSignedData(
+                data=signed, share_idx=self.share_idx
+            )
+        for duty, signed_set in by_duty.items():
+            for sub in self._subs:
+                await sub(duty, signed_set)
+
+    async def submit_proposal(self, pubkey: PubKey, proposal: Proposal, signature: bytes) -> None:
+        signed = SignedData("block", proposal, signature)
+        self._check_batch([self._verify_item(pubkey, signed, proposal.header.slot)])
+        duty = Duty(proposal.header.slot, DutyType.PROPOSER)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def submit_randao(self, slot: int, pubkey: PubKey, signature: bytes) -> None:
+        """Randao reveals arrive with proposal requests
+        (ref: validatorapi.go:335 Proposal flow)."""
+        epoch = slot // self.slots_per_epoch
+        signed = SignedData("randao", epoch, signature)
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.RANDAO)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    # -- helpers -----------------------------------------------------------
+
+    def _verify_item(self, pubkey: PubKey, signed: SignedData, slot: int):
+        pubshare = self.pubshares.get(pubkey)
+        if pubshare is None:
+            raise VapiError(f"unknown validator {pubkey}")
+        root = signed.signing_root(self.fork, slot // self.slots_per_epoch)
+        return (pubshare, root, signed.signature)
+
+    def _check_batch(self, items) -> None:
+        """Verify partial signatures against pubshares — batched
+        (ref: validatorapi.go:1213 one herumi call per signature)."""
+        ok = tbls.verify_batch(items)
+        if not all(ok):
+            raise VapiError("partial signature failed pubshare verification")

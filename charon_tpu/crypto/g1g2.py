@@ -94,15 +94,11 @@ def g1_double(pt):
 
 
 def g1_mul_raw(pt, k: int):
-    """Scalar mul WITHOUT reducing k mod r (for cofactor clearing)."""
-    out = None
-    add = pt
-    while k:
-        if k & 1:
-            out = g1_add(out, add)
-        add = g1_add(add, add)
-        k >>= 1
-    return out
+    """Scalar mul WITHOUT reducing k mod r (for cofactor clearing).
+
+    Jacobian double-and-add: one field inversion total, vs one per affine
+    add — ~100x faster for 255-bit scalars."""
+    return _jac_mul(pt, k, _FP_OPS)
 
 
 def g1_mul(pt, k: int):
@@ -155,14 +151,7 @@ def g2_double(pt):
 
 
 def g2_mul_raw(pt, k: int):
-    out = None
-    add = pt
-    while k:
-        if k & 1:
-            out = g2_add(out, add)
-        add = g2_add(add, add)
-        k >>= 1
-    return out
+    return _jac_mul(pt, k, _FP2_OPS)
 
 
 def g2_mul(pt, k: int):
@@ -171,6 +160,102 @@ def g2_mul(pt, k: int):
 
 def g2_in_subgroup(pt) -> bool:
     return g2_is_on_curve(pt) and g2_mul_raw(pt, R) is None
+
+
+# ---------------------------------------------------------------------------
+# Jacobian scalar multiplication (host-speed path; affine ops above remain
+# the simple correctness oracle)
+# ---------------------------------------------------------------------------
+
+# Generic field-op tables: (add, sub, mul, sqr, neg, inv, is_zero, zero)
+_FP_OPS = (
+    lambda a, b: (a + b) % P,
+    lambda a, b: (a - b) % P,
+    lambda a, b: a * b % P,
+    lambda a: a * a % P,
+    lambda a: (-a) % P,
+    fp_inv,
+    lambda a: a % P == 0,
+    0,
+)
+_FP2_OPS = (
+    fp2_add,
+    fp2_sub,
+    fp2_mul,
+    fp2_sqr,
+    fp2_neg,
+    fp2_inv,
+    fp2_is_zero,
+    (0, 0),
+)
+
+
+def _jac_double(p, ops):
+    add, sub, mul, sqr, neg, _, is_zero, _z = ops
+    x, y, z = p
+    if is_zero(z):
+        return p
+    a = sqr(x)
+    b = sqr(y)
+    c = sqr(b)
+    d = sub(sub(sqr(add(x, b)), a), c)
+    d = add(d, d)
+    e = add(add(a, a), a)
+    f = sqr(e)
+    x3 = sub(f, add(d, d))
+    c8 = add(add(c, c), add(c, c))
+    c8 = add(c8, c8)
+    y3 = sub(mul(e, sub(d, x3)), c8)
+    z3 = mul(add(y, y), z)
+    return (x3, y3, z3)
+
+
+def _jac_add_affine(p, q, ops):
+    """Jacobian p + affine q (q != infinity)."""
+    add, sub, mul, sqr, neg, _, is_zero, zero = ops
+    x1, y1, z1 = p
+    x2, y2 = q
+    if is_zero(z1):
+        one = (1, 0) if isinstance(x2, tuple) else 1
+        return (x2, y2, one)
+    z1z1 = sqr(z1)
+    u2 = mul(x2, z1z1)
+    s2 = mul(mul(y2, z1), z1z1)
+    if sub(u2, x1) == zero:
+        if sub(s2, y1) == zero:
+            return _jac_double(p, ops)
+        return (zero, zero, zero)  # p + (-p) = infinity (z == 0)
+    h = sub(u2, x1)
+    hh = sqr(h)
+    i = add(add(hh, hh), add(hh, hh))
+    j = mul(h, i)
+    r = sub(s2, y1)
+    r = add(r, r)
+    v = mul(x1, i)
+    x3 = sub(sub(sqr(r), j), add(v, v))
+    y1j = mul(y1, j)
+    y3 = sub(mul(r, sub(v, x3)), add(y1j, y1j))
+    z3 = mul(add(z1, h), add(z1, h))
+    z3 = sub(sub(z3, sqr(z1)), hh)
+    return (x3, y3, z3)
+
+
+def _jac_mul(pt, k: int, ops):
+    if pt is None or k == 0:
+        return None
+    add, sub, mul, sqr, neg, inv, is_zero, _ = ops
+    zero = (0, 0) if isinstance(pt[0], tuple) else 0
+    acc = (zero, zero, zero)  # infinity: z == 0
+    for bit in bin(k)[2:]:
+        acc = _jac_double(acc, ops)
+        if bit == "1":
+            acc = _jac_add_affine(acc, pt, ops)
+    x, y, z = acc
+    if is_zero(z):
+        return None
+    zinv = inv(z)
+    zinv2 = sqr(zinv)
+    return (mul(x, zinv2), mul(mul(y, zinv2), zinv))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,7 @@
+"""Test substrate: beaconmock, validatormock, simnet helpers.
+
+Mirrors ref: testutil/ — the reference proves that building the fakes
+*before* the real components makes the whole stack testable in one process
+(ref: testutil/beaconmock/beaconmock.go, testutil/validatormock/,
+app/app.go:862-897 simnet wiring).
+"""

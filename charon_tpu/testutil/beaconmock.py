@@ -1,0 +1,135 @@
+"""BeaconMock: a programmable in-process fake beacon node.
+
+Mirrors ref: testutil/beaconmock — deterministic duties, static chain
+spec, canned attestation data, and recording submit endpoints, with
+override options in the same spirit as beaconmock/options.go
+(WithDeterministicAttesterDuties, WithSlotDuration, WithValidatorSet...).
+All components consume it through the same duck-typed beacon interface as
+the production client.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+from charon_tpu.core.deadline import SlotClock
+from charon_tpu.core.eth2data import (
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    Proposal,
+)
+from charon_tpu.core.types import PubKey
+
+
+@dataclass
+class BeaconMock:
+    """validators: pubkey -> validator index. Deterministic single-committee
+    attester duties per slot; proposer duty round-robin by slot."""
+
+    validators: dict[PubKey, int] = field(default_factory=dict)
+    genesis_time: float = field(default_factory=lambda: time.time())
+    slot_duration: float = 1.0
+    slots_per_epoch: int = 16
+    synced: bool = True
+
+    def __post_init__(self) -> None:
+        self.attestations: list = []
+        self.proposals: list = []
+        self.registrations: list = []
+        self.exits: list = []
+        # test override hooks (ref: beaconmock/options.go pattern)
+        self.attestation_data_fn = self._attestation_data_default
+
+    # -- chain metadata ---------------------------------------------------
+
+    def clock(self) -> SlotClock:
+        return SlotClock(self.genesis_time, self.slot_duration)
+
+    async def await_synced(self) -> None:
+        return None
+
+    # -- duties -----------------------------------------------------------
+
+    async def attester_duties(self, epoch: int, validators: dict[PubKey, int]):
+        """Every validator attests every slot in its own committee —
+        deterministic (ref: beaconmock WithDeterministicAttesterDuties)."""
+        out = []
+        for slot in range(
+            epoch * self.slots_per_epoch, (epoch + 1) * self.slots_per_epoch
+        ):
+            for i, (pubkey, vidx) in enumerate(sorted(validators.items())):
+                out.append(
+                    dict(
+                        slot=slot,
+                        pubkey=pubkey,
+                        validator_index=vidx,
+                        committee_index=i,
+                        committee_length=1,
+                        committees_at_slot=max(1, len(validators)),
+                        validator_committee_index=0,
+                    )
+                )
+        return out
+
+    async def proposer_duties(self, epoch: int, validators: dict[PubKey, int]):
+        out = []
+        ordered = sorted(validators.items())
+        if not ordered:
+            return out
+        for slot in range(
+            epoch * self.slots_per_epoch, (epoch + 1) * self.slots_per_epoch
+        ):
+            pubkey, vidx = ordered[slot % len(ordered)]
+            out.append(dict(slot=slot, pubkey=pubkey, validator_index=vidx))
+        return out
+
+    # -- duty data --------------------------------------------------------
+
+    def _root(self, *parts) -> bytes:
+        h = hashlib.sha256()
+        for p in parts:
+            h.update(str(p).encode())
+        return h.digest()
+
+    def _attestation_data_default(self, slot: int, committee_index: int) -> AttestationData:
+        epoch = slot // self.slots_per_epoch
+        return AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=self._root("block", slot),
+            source=Checkpoint(max(0, epoch - 1), self._root("cp", epoch - 1)),
+            target=Checkpoint(epoch, self._root("cp", epoch)),
+        )
+
+    async def attestation_data(self, slot: int, committee_index: int) -> AttestationData:
+        return self.attestation_data_fn(slot, committee_index)
+
+    async def block_proposal(self, slot: int, proposer_index: int, randao: bytes) -> Proposal:
+        body = b"mock-body:" + randao[:8]
+        return Proposal(
+            header=BeaconBlockHeader(
+                slot=slot,
+                proposer_index=proposer_index,
+                parent_root=self._root("block", slot - 1),
+                state_root=self._root("state", slot, randao.hex()),
+                body_root=hashlib.sha256(body).digest(),
+            ),
+            body=body,
+        )
+
+    # -- submissions ------------------------------------------------------
+
+    async def submit_attestation(self, att) -> None:
+        self.attestations.append(att)
+
+    async def submit_proposal(self, proposal, signature: bytes) -> None:
+        self.proposals.append((proposal, signature))
+
+    async def submit_registration(self, reg, signature: bytes) -> None:
+        self.registrations.append((reg, signature))
+
+    async def submit_exit(self, exit_msg, signature: bytes) -> None:
+        self.exits.append((exit_msg, signature))

@@ -1,0 +1,60 @@
+"""ValidatorMock: a fake validator client driving the ValidatorAPI.
+
+Mirrors ref: testutil/validatormock — holds this node's *share* private
+keys and performs duties against the vapi: pull attestation data, sign
+with the share key, submit the partial signature (ref:
+testutil/validatormock/attest.go, propose.go; wired in-process by
+app/vmock.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from charon_tpu import tbls
+from charon_tpu.core.eth2data import Attestation, SignedData
+from charon_tpu.core.scheduler import DutyDefinition
+from charon_tpu.core.types import PubKey
+from charon_tpu.core.validatorapi import ValidatorAPI
+from charon_tpu.eth2util.signing import ForkInfo
+
+
+@dataclass
+class ValidatorMock:
+    """share_keys: group pubkey -> this node's share private key bytes."""
+
+    vapi: ValidatorAPI
+    share_keys: dict[PubKey, bytes]
+    fork: ForkInfo
+    slots_per_epoch: int = 32
+
+    async def attest(self, slot: int, defs: dict[PubKey, DutyDefinition]) -> None:
+        """Perform the attester duty for all our validators in this slot
+        (ref: validatormock/attest.go)."""
+        atts = []
+        for pubkey, d in defs.items():
+            data = await self.vapi.attestation_data(slot, d.committee_index)
+            bits = tuple(
+                i == d.validator_committee_index
+                for i in range(d.committee_length)
+            )
+            unsigned = Attestation(aggregation_bits=bits, data=data)
+            root = SignedData("attestation", unsigned).signing_root(
+                self.fork, slot // self.slots_per_epoch
+            )
+            sig = tbls.sign(self.share_keys[pubkey], root)
+            atts.append(Attestation(bits, data, sig))
+        if atts:
+            await self.vapi.submit_attestations(atts)
+
+    async def propose(self, slot: int, pubkey: PubKey) -> None:
+        """Randao partial then signed proposal (ref: validatormock/propose.go)."""
+        epoch = slot // self.slots_per_epoch
+        randao_root = SignedData("randao", epoch).signing_root(self.fork, epoch)
+        randao_sig = tbls.sign(self.share_keys[pubkey], randao_root)
+        await self.vapi.submit_randao(slot, pubkey, randao_sig)
+
+        proposal = await self.vapi.proposal(slot, pubkey)
+        root = SignedData("block", proposal).signing_root(self.fork, epoch)
+        sig = tbls.sign(self.share_keys[pubkey], root)
+        await self.vapi.submit_proposal(pubkey, proposal, sig)
