@@ -1,0 +1,144 @@
+"""QBFT consensus adapter: binds the pure engine to the duty workflow.
+
+Mirrors ref: core/consensus/qbft — consensus runs over 32-byte value
+hashes with the actual unsigned-data sets carried alongside in a
+values-by-hash cache (ref: core/consensus/qbft/transport.go:63-90), a
+deterministic round-robin leader (ref qbft.go:706), and per-duty engine
+instances started by propose/participate (ref qbft.go:247,317).
+
+The in-memory transport is the simnet path; the p2p transport (signed
+protobuf messages) plugs into the same MsgNet interface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from typing import Awaitable, Callable
+
+from charon_tpu.core import qbft
+from charon_tpu.core.types import Duty, PubKey
+
+DecidedSub = Callable[[Duty, dict[PubKey, object]], Awaitable[None]]
+
+
+def value_hash(unsigned_set: dict[PubKey, object]) -> bytes:
+    """Canonical hash of an unsigned duty data set: consensus agrees on
+    hashes, values travel out-of-band (ref: transport.go:63 values-by-hash).
+    Frozen dataclasses repr deterministically."""
+    items = sorted(unsigned_set.items())
+    return hashlib.sha256(repr(items).encode()).digest()
+
+
+class MemMsgNet:
+    """In-memory QBFT message fabric for one cluster: routes engine
+    messages and replicates the value cache (simnet only — production uses
+    the signed p2p transport)."""
+
+    def __init__(self) -> None:
+        self.nodes: list["QBFTConsensus"] = []
+
+    def attach(self, node: "QBFTConsensus") -> int:
+        self.nodes.append(node)
+        return len(self.nodes) - 1
+
+    async def broadcast(self, from_idx: int, duty: Duty, msg: qbft.Msg, values) -> None:
+        for node in self.nodes:
+            if node.node_idx != from_idx:
+                node.deliver(duty, msg, values)
+
+
+class QBFTConsensus:
+    protocol_id = "qbft/2.0.0"
+
+    def __init__(
+        self,
+        net: MemMsgNet,
+        nodes: int,
+        round_timeout: float = 0.75,
+        round_increase: float = 0.25,
+    ) -> None:
+        self.net = net
+        self.node_idx = net.attach(self)
+
+        def leader(instance, rnd: int) -> int:
+            """Deterministic round-robin (ref: qbft.go:706)."""
+            h = int.from_bytes(
+                hashlib.sha256(repr(instance).encode()).digest()[:8], "big"
+            )
+            return (h + rnd) % nodes
+
+        self.defn = qbft.Definition(
+            nodes=nodes,
+            leader=leader,
+            # ref-equivalent increasing round timer
+            # (core/consensus/utils/roundtimer.go:17-19)
+            timeout=lambda r: round_timeout + round_increase * r,
+        )
+        self._subs: list[DecidedSub] = []
+        self._values: dict[bytes, dict[PubKey, object]] = {}
+        self._instances: dict[Duty, qbft.Transport] = {}
+        self._running: dict[Duty, asyncio.Task] = {}
+        self._decided: set[Duty] = set()
+
+    def subscribe(self, sub: DecidedSub) -> None:
+        self._subs.append(sub)
+
+    # -- engine plumbing ---------------------------------------------------
+
+    def _transport(self, duty: Duty) -> qbft.Transport:
+        tr = self._instances.get(duty)
+        if tr is None:
+
+            async def bcast(msg: qbft.Msg) -> None:
+                await self.net.broadcast(
+                    self.node_idx, duty, msg, dict(self._values)
+                )
+
+            tr = qbft.Transport(bcast)
+            self._instances[duty] = tr
+        return tr
+
+    def deliver(self, duty: Duty, msg: qbft.Msg, values) -> None:
+        """Incoming message from the fabric; values-by-hash cache merge."""
+        self._values.update(values)
+        self._transport(duty).inbox.put_nowait(msg)
+
+    def _ensure_running(self, duty: Duty, value_hash_or_none) -> asyncio.Task:
+        task = self._running.get(duty)
+        if task is None:
+            tr = self._transport(duty)
+            task = asyncio.create_task(
+                self._run_instance(duty, tr, value_hash_or_none)
+            )
+            self._running[duty] = task
+        return task
+
+    async def _run_instance(self, duty: Duty, tr: qbft.Transport, vhash) -> None:
+        decided_hash = await qbft.run(
+            self.defn, tr, duty, self.node_idx, vhash
+        )
+        if duty in self._decided:
+            return
+        self._decided.add(duty)
+        unsigned_set = self._values.get(decided_hash)
+        if unsigned_set is None:
+            raise RuntimeError(
+                f"decided hash with no value in cache for {duty}"
+            )
+        for sub in self._subs:
+            await sub(duty, unsigned_set)
+
+    # -- workflow API ------------------------------------------------------
+
+    async def propose(self, duty: Duty, unsigned_set: dict[PubKey, object]) -> None:
+        """ref: core/consensus/qbft/qbft.go:247 Propose."""
+        vhash = value_hash(unsigned_set)
+        self._values[vhash] = unsigned_set
+        task = self._ensure_running(duty, vhash)
+        await asyncio.shield(task)
+
+    async def participate(self, duty: Duty) -> None:
+        """Join the instance without a proposal
+        (ref: core/consensus/qbft/qbft.go:317 Participate)."""
+        self._ensure_running(duty, None)

@@ -1,0 +1,411 @@
+"""QBFT: a pure, transport-agnostic implementation of the Istanbul BFT
+consensus algorithm (Moniz, arXiv:2002.03613).
+
+Plays the role of ref: core/qbft/qbft.go — a generic engine with zero
+dependencies, driven entirely through a Definition (validation, leader
+selection, timers) and a Transport (broadcast + inbound queue), so the
+simnet runs it over in-memory channels and production over the p2p layer.
+This is a from-scratch implementation of the published algorithm, asyncio
+style: one `run` coroutine per consensus instance.
+
+Quorum: ceil(2n/3); tolerates floor((n-1)/3) byzantine nodes.
+
+The subtle parts, implemented per the paper:
+  * PRE-PREPARE justification for round > 1 (a quorum of ROUND-CHANGEs,
+    and the proposed value must match the highest prepared value among
+    them, which itself must be justified by a PREPARE quorum);
+  * ROUND-CHANGE carries (prepared_round, prepared_value) plus the
+    PREPARE messages justifying them;
+  * f+1 ROUND-CHANGEs ahead of us pull us into the smallest such round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import math
+from dataclasses import dataclass, field, replace
+from typing import Awaitable, Callable, Hashable, Sequence
+
+
+class MsgType(enum.IntEnum):
+    PRE_PREPARE = 1
+    PREPARE = 2
+    COMMIT = 3
+    ROUND_CHANGE = 4
+
+
+@dataclass(frozen=True)
+class Msg:
+    """One QBFT message. `value` is the proposed value (hashable; the
+    adapter layer uses 32-byte hashes with values carried out-of-band, ref:
+    core/consensus/qbft/transport.go values-by-hash). Justification carries
+    piggybacked messages for PRE-PREPARE/ROUND-CHANGE rules."""
+
+    type: MsgType
+    instance: Hashable
+    source: int  # node index 0..n-1
+    round: int
+    value: Hashable | None = None
+    prepared_round: int = 0
+    prepared_value: Hashable | None = None
+    justification: tuple["Msg", ...] = ()
+
+
+@dataclass
+class Definition:
+    """Parameters binding the pure engine to an environment."""
+
+    nodes: int
+    leader: Callable[[Hashable, int], int]  # (instance, round) -> node idx
+    # round -> timeout seconds (ref-equivalent default: 0.75 + 0.25*round)
+    timeout: Callable[[int], float] = lambda r: 0.75 + 0.25 * r
+    is_valid: Callable[[Msg], bool] = lambda m: True
+
+    @property
+    def quorum(self) -> int:
+        return math.ceil(2 * self.nodes / 3)
+
+    @property
+    def faulty(self) -> int:
+        return (self.nodes - 1) // 3
+
+
+class Transport:
+    """Broadcast + inbound queue. The engine owns no sockets."""
+
+    def __init__(self, broadcast: Callable[[Msg], Awaitable[None]]):
+        self.broadcast = broadcast
+        self.inbox: asyncio.Queue[Msg] = asyncio.Queue()
+
+
+async def run(
+    defn: Definition,
+    transport: Transport,
+    instance: Hashable,
+    node: int,
+    value: Hashable | None,
+    value_ch: asyncio.Future | None = None,
+) -> Hashable:
+    """Run one QBFT instance until it decides; returns the decided value.
+
+    `value` is this node's proposal input (may be None initially with a
+    `value_ch` future supplying it later — the participate-then-propose
+    pattern, ref: core/consensus/qbft/qbft.go Propose vs Participate).
+    """
+    engine = _Engine(defn, transport, instance, node)
+    return await engine.run(value, value_ch)
+
+
+class _Engine:
+    def __init__(self, defn: Definition, transport: Transport, instance, node: int):
+        self.d = defn
+        self.t = transport
+        self.instance = instance
+        self.node = node
+        self.round = 1
+        self.prepared_round = 0
+        self.prepared_value = None
+        self.prepare_quorum_just: tuple[Msg, ...] = ()
+        self.input_value = None
+        # dedup: (type, source, round) -> Msg (first wins per slot)
+        self.msgs: dict[tuple[MsgType, int, int], Msg] = {}
+        self.sent_prepare: set[int] = set()
+        self.sent_commit: set[int] = set()
+        self.sent_preprepare: set[int] = set()
+        self.sent_round_change: set[int] = set()
+        self.decided: asyncio.Future = None  # type: ignore
+
+    # -- helpers ----------------------------------------------------------
+
+    def _collect(self, typ: MsgType, rnd: int) -> list[Msg]:
+        return [
+            m
+            for (t, _, r), m in self.msgs.items()
+            if t == typ and r == rnd
+        ]
+
+    def _quorum_value(self, typ: MsgType, rnd: int) -> Hashable | None:
+        """Value (or hash) agreed by a quorum of messages of typ@rnd."""
+        counts: dict = {}
+        for m in self._collect(typ, rnd):
+            counts[m.value] = counts.get(m.value, 0) + 1
+            if counts[m.value] >= self.d.quorum:
+                return m.value
+        return None
+
+    async def _send(self, msg: Msg) -> None:
+        await self.t.broadcast(msg)
+        # Loopback: our own message must also drive the upon-rules (it may
+        # be the final piece of a quorum). Recursion is bounded by the
+        # sent_* dedup sets.
+        if self._accept(msg):
+            await self._on_msg(msg)
+
+    def _accept(self, msg: Msg) -> bool:
+        if msg.instance != self.instance:
+            return False
+        if not (0 <= msg.source < self.d.nodes):
+            return False
+        if not self.d.is_valid(msg):
+            return False
+        key = (msg.type, msg.source, msg.round)
+        if key in self.msgs:
+            return False
+        self.msgs[key] = msg
+        return True
+
+    # -- justification rules (paper §4.4) ---------------------------------
+
+    def _highest_prepared(self, rcs: Sequence[Msg]) -> Msg | None:
+        best = None
+        for m in rcs:
+            if m.prepared_round > 0 and (
+                best is None or m.prepared_round > best.prepared_round
+            ):
+                best = m
+        return best
+
+    def _justify_preprepare(self, msg: Msg) -> bool:
+        if msg.round == 1:
+            return True
+        rcs = [
+            j
+            for j in msg.justification
+            if j.type == MsgType.ROUND_CHANGE
+            and j.round == msg.round
+            and j.instance == self.instance
+        ]
+        # distinct senders, quorum
+        senders = {j.source for j in rcs}
+        if len(senders) < self.d.quorum:
+            return False
+        best = self._highest_prepared(rcs)
+        if best is None:
+            return True  # free to propose anything
+        if msg.value != best.prepared_value:
+            return False
+        # the claimed prepared value must be backed by a PREPARE quorum
+        prepares = [
+            j
+            for j in msg.justification
+            if j.type == MsgType.PREPARE
+            and j.round == best.prepared_round
+            and j.value == best.prepared_value
+        ]
+        return len({j.source for j in prepares}) >= self.d.quorum
+
+    # -- main loop --------------------------------------------------------
+
+    async def run(self, value, value_ch) -> Hashable:
+        loop = asyncio.get_running_loop()
+        self.decided = loop.create_future()
+        self.input_value = value
+        timer_task: asyncio.Task | None = None
+
+        async def round_timer(rnd: int):
+            await asyncio.sleep(self.d.timeout(rnd))
+            await self._on_timeout(rnd)
+
+        def restart_timer():
+            nonlocal timer_task
+            if timer_task is not None:
+                timer_task.cancel()
+            timer_task = asyncio.create_task(round_timer(self.round))
+
+        self._restart_timer = restart_timer
+        restart_timer()
+
+        if value is None and value_ch is not None:
+
+            async def await_value():
+                v = await value_ch
+                self.input_value = v
+                await self._maybe_propose()
+
+            value_task = asyncio.create_task(await_value())
+        else:
+            value_task = None
+
+        await self._maybe_propose()
+
+        try:
+            while not self.decided.done():
+                get = asyncio.create_task(self.t.inbox.get())
+                done, _ = await asyncio.wait(
+                    {get, self.decided},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if self.decided.done():
+                    get.cancel()
+                    break
+                msg = get.result()
+                prev_round = self.round
+                if self._accept(msg):
+                    await self._on_msg(msg)
+                if self.round != prev_round:
+                    restart_timer()
+            return self.decided.result()
+        finally:
+            if timer_task is not None:
+                timer_task.cancel()
+            if value_task is not None:
+                value_task.cancel()
+
+    async def _maybe_propose(self) -> None:
+        """Leader of round 1 sends the PRE-PREPARE when it has a value."""
+        if (
+            self.input_value is not None
+            and self.d.leader(self.instance, self.round) == self.node
+            and self.round not in self.sent_preprepare
+        ):
+            just = ()
+            if self.round > 1:
+                just = self._round_change_justification(self.round)
+                if just is None:
+                    return
+            self.sent_preprepare.add(self.round)
+            await self._send(
+                Msg(
+                    MsgType.PRE_PREPARE,
+                    self.instance,
+                    self.node,
+                    self.round,
+                    self._leader_value(self.round),
+                    justification=tuple(just),
+                )
+            )
+
+    def _leader_value(self, rnd: int):
+        rcs = self._collect(MsgType.ROUND_CHANGE, rnd)
+        best = self._highest_prepared(rcs)
+        if best is not None:
+            return best.prepared_value
+        return self.input_value
+
+    def _round_change_justification(self, rnd: int):
+        rcs = self._collect(MsgType.ROUND_CHANGE, rnd)
+        if len({m.source for m in rcs}) < self.d.quorum:
+            return None
+        just = list(rcs)
+        best = self._highest_prepared(rcs)
+        if best is not None:
+            just.extend(best.justification)  # piggybacked PREPARE quorum
+        return just
+
+    async def _on_msg(self, msg: Msg) -> None:
+        d = self.d
+        # uponRule: PRE-PREPARE from the round's leader, justified.
+        if msg.type == MsgType.PRE_PREPARE:
+            if msg.source != d.leader(self.instance, msg.round):
+                return
+            if not self._justify_preprepare(msg):
+                return
+            if msg.round < self.round:
+                return
+            if msg.round > self.round:
+                # catch up to the pre-prepared round (paper: accept
+                # justified pre-prepare for a future round)
+                self.round = msg.round
+            if self.round not in self.sent_prepare:
+                self.sent_prepare.add(self.round)
+                await self._send(
+                    Msg(
+                        MsgType.PREPARE,
+                        self.instance,
+                        self.node,
+                        self.round,
+                        msg.value,
+                    )
+                )
+
+        elif msg.type == MsgType.PREPARE:
+            v = self._quorum_value(MsgType.PREPARE, self.round)
+            if v is not None and self.round not in self.sent_commit:
+                self.prepared_round = self.round
+                self.prepared_value = v
+                self.prepare_quorum_just = tuple(
+                    m
+                    for m in self._collect(MsgType.PREPARE, self.round)
+                    if m.value == v
+                )
+                self.sent_commit.add(self.round)
+                await self._send(
+                    Msg(
+                        MsgType.COMMIT,
+                        self.instance,
+                        self.node,
+                        self.round,
+                        v,
+                    )
+                )
+
+        elif msg.type == MsgType.COMMIT:
+            # decide on any round's commit quorum
+            v = self._quorum_value(MsgType.COMMIT, msg.round)
+            if v is not None and not self.decided.done():
+                self.decided.set_result(v)
+
+        elif msg.type == MsgType.ROUND_CHANGE:
+            await self._on_round_change(msg)
+
+    async def _on_round_change(self, msg: Msg) -> None:
+        d = self.d
+        # f+1 round-changes ahead of us: jump to the smallest of them.
+        ahead = [
+            m
+            for m in (
+                m
+                for (t, _, r), m in self.msgs.items()
+                if t == MsgType.ROUND_CHANGE and r > self.round
+            )
+        ]
+        if len({m.source for m in ahead}) >= d.faulty + 1:
+            self.round = min(m.round for m in ahead)
+            await self._broadcast_round_change()
+
+        # leader of msg.round with a quorum: send justified PRE-PREPARE.
+        if (
+            msg.round >= self.round
+            and d.leader(self.instance, msg.round) == self.node
+            and msg.round not in self.sent_preprepare
+        ):
+            just = self._round_change_justification(msg.round)
+            if just is not None and (
+                self._leader_value(msg.round) is not None
+            ):
+                self.round = msg.round
+                self.sent_preprepare.add(msg.round)
+                await self._send(
+                    Msg(
+                        MsgType.PRE_PREPARE,
+                        self.instance,
+                        self.node,
+                        msg.round,
+                        self._leader_value(msg.round),
+                        justification=tuple(just),
+                    )
+                )
+
+    async def _on_timeout(self, rnd: int) -> None:
+        if self.decided.done() or rnd != self.round:
+            return
+        self.round += 1
+        self._restart_timer()
+        await self._broadcast_round_change()
+
+    async def _broadcast_round_change(self) -> None:
+        if self.round in self.sent_round_change:
+            return
+        self.sent_round_change.add(self.round)
+        await self._send(
+            Msg(
+                MsgType.ROUND_CHANGE,
+                self.instance,
+                self.node,
+                self.round,
+                prepared_round=self.prepared_round,
+                prepared_value=self.prepared_value,
+                justification=self.prepare_quorum_just,
+            )
+        )

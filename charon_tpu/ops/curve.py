@@ -52,6 +52,8 @@ class FieldOps:
     zero: Callable  # (batch_shape) -> 0
     one: Callable  # (batch_shape) -> 1
     batch_shape: Callable  # element -> batch shape tuple
+    batch: Callable  # (ops list of ("mul",a,b)/("sqr",a)) -> results; one
+    # stacked base mul per dependency level (see fptower.fp2_batch)
 
 
 @functools.lru_cache(maxsize=None)
@@ -73,6 +75,7 @@ def g1_ops(ctx: ModCtx) -> FieldOps:
         zero=lambda shape=(): limb.zeros(ctx, shape),
         one=lambda shape=(): limb.const(ctx, 1, shape),
         batch_shape=lambda a: a.shape[:-1],
+        batch=functools.partial(_fp_batch, ctx),
     )
 
 
@@ -95,7 +98,24 @@ def g2_ops(ctx: ModCtx) -> FieldOps:
         zero=lambda shape=(): T.fp2_zero(ctx, shape),
         one=lambda shape=(): T.fp2_one(ctx, shape),
         batch_shape=lambda a: a[0].shape[:-1],
+        batch=functools.partial(T.fp2_batch, ctx),
     )
+
+
+def _fp_batch(ctx, ops):
+    """Stacked base muls for the Fp (G1) field — mirrors fptower.fp2_batch."""
+    xs, ys = [], []
+    for op in ops:
+        if op[0] == "mul":
+            xs.append(op[1])
+            ys.append(op[2])
+        elif op[0] == "sqr":
+            xs.append(op[1])
+            ys.append(op[1])
+        else:
+            raise ValueError(op[0])
+    prods = limb.mont_mul(ctx, jnp.stack(xs), jnp.stack(ys))
+    return [prods[i] for i in range(len(ops))]
 
 
 def _small_fp(ctx, a, k: int):
@@ -122,45 +142,61 @@ def point_identity(f: FieldOps, batch_shape=()):
 
 
 def point_add(f: FieldOps, p, q):
-    """Complete addition, RCB15 algorithm 7 (a=0). 12 field muls."""
+    """Complete addition, RCB15 algorithm 7 (a=0). 12 field muls in two
+    stacked levels."""
     x1, y1, z1 = p
     x2, y2, z2 = q
-    t0 = f.mul(x1, x2)
-    t1 = f.mul(y1, y2)
-    t2 = f.mul(z1, z2)
-    t3 = f.mul(f.add(x1, y1), f.add(x2, y2))
-    t3 = f.sub(t3, f.add(t0, t1))  # x1y2 + x2y1
-    t4 = f.mul(f.add(y1, z1), f.add(y2, z2))
-    t4 = f.sub(t4, f.add(t1, t2))  # y1z2 + y2z1
-    x3 = f.mul(f.add(x1, z1), f.add(x2, z2))
-    y3 = f.sub(x3, f.add(t0, t2))  # x1z2 + x2z1
-    x3 = f.add(t0, t0)
-    t0 = f.add(x3, t0)  # 3 x1x2
+    t0, t1, t2, a, b, c = f.batch(
+        [
+            ("mul", x1, x2),
+            ("mul", y1, y2),
+            ("mul", z1, z2),
+            ("mul", f.add(x1, y1), f.add(x2, y2)),
+            ("mul", f.add(y1, z1), f.add(y2, z2)),
+            ("mul", f.add(x1, z1), f.add(x2, z2)),
+        ]
+    )
+    t3 = f.sub(a, f.add(t0, t1))  # x1y2 + x2y1
+    t4 = f.sub(b, f.add(t1, t2))  # y1z2 + y2z1
+    y3 = f.sub(c, f.add(t0, t2))  # x1z2 + x2z1
+    t0 = f.small(t0, 3)  # 3 x1x2
     t2 = f.mul_b3(t2)  # b3 z1z2
     z3 = f.add(t1, t2)
     t1 = f.sub(t1, t2)
     y3 = f.mul_b3(y3)  # b3 (x1z2 + x2z1)
-    x3 = f.sub(f.mul(t3, t1), f.mul(t4, y3))
-    y3 = f.add(f.mul(y3, t0), f.mul(t1, z3))
-    z3 = f.add(f.mul(z3, t4), f.mul(t0, t3))
-    return (x3, y3, z3)
+    m1, m2, m3, m4, m5, m6 = f.batch(
+        [
+            ("mul", t3, t1),
+            ("mul", t4, y3),
+            ("mul", y3, t0),
+            ("mul", t1, z3),
+            ("mul", z3, t4),
+            ("mul", t0, t3),
+        ]
+    )
+    return (f.sub(m1, m2), f.add(m3, m4), f.add(m5, m6))
 
 
 def point_double(f: FieldOps, p):
-    """Complete doubling, RCB15 algorithm 9 (a=0). 6 muls + 2 squarings."""
+    """Complete doubling, RCB15 algorithm 9 (a=0). 6 muls + 2 squarings in
+    two stacked levels."""
     x, y, z = p
-    t0 = f.sqr(y)
-    z3 = f.small(t0, 8)
-    t1 = f.mul(y, z)
-    t2 = f.mul_b3(f.sqr(z))
-    x3 = f.mul(t2, z3)
+    t0, t1, zz, xy = f.batch(
+        [("sqr", y), ("mul", y, z), ("sqr", z), ("mul", x, y)]
+    )
+    z3c = f.small(t0, 8)
+    t2 = f.mul_b3(zz)
     y3 = f.add(t0, t2)
-    z3 = f.mul(t1, z3)
-    t2 = f.small(t2, 3)
-    t0 = f.sub(t0, t2)
-    y3 = f.add(f.mul(t0, y3), x3)
-    x3 = f.double(f.mul(f.mul(x, y), t0))
-    return (x3, y3, z3)
+    t0 = f.sub(t0, f.small(t2, 3))
+    x3, z3, ty, xyt = f.batch(
+        [
+            ("mul", t2, z3c),
+            ("mul", t1, z3c),
+            ("mul", t0, y3),
+            ("mul", xy, t0),
+        ]
+    )
+    return (f.double(xyt), f.add(ty, x3), z3)
 
 
 def point_neg(f: FieldOps, p):

@@ -49,25 +49,34 @@ X_BITS_FULL = np.array([int(b) for b in bin(X_ABS)[2:]], np.uint8)
 
 
 def fp12_mul_sparse_line(ctx, f, l0, l1, l2):
-    """18 fp2 muls vs 36 for a dense fp12 mul (spec: pairing_fast.py:79)."""
+    """18 fp2 muls vs 36 for a dense fp12 mul (spec: pairing_fast.py:79) —
+    all independent, executed as ONE stacked base mul."""
     (a0, a1, a2), (b0, b1, b2) = f
-    mul = functools.partial(T.fp2_mul, ctx)
     add = functools.partial(T.fp2_add, ctx)
     xi = functools.partial(T.fp2_mul_xi, ctx)
 
-    t0 = (mul(a0, l0), mul(a1, l0), mul(a2, l0))
+    p = T.fp2_mul_many(
+        ctx,
+        [
+            (a0, l0), (a1, l0), (a2, l0),          # t0
+            (b1, l2), (b2, l1), (b0, l1), (b2, l2), (b0, l2), (b1, l1),  # t1
+            (a1, l2), (a2, l1), (a0, l1), (a2, l2), (a0, l2), (a1, l1),  # a*L1
+            (b0, l0), (b1, l0), (b2, l0),          # b*L0
+        ],
+    )
+    t0 = (p[0], p[1], p[2])
     t1 = (
-        xi(add(mul(b1, l2), mul(b2, l1))),
-        add(mul(b0, l1), xi(mul(b2, l2))),
-        add(mul(b0, l2), mul(b1, l1)),
+        xi(add(p[3], p[4])),
+        add(p[5], xi(p[6])),
+        add(p[7], p[8]),
     )
     c0 = (add(t0[0], xi(t1[2])), add(t0[1], t1[0]), add(t0[2], t1[1]))
     a_l1 = (
-        xi(add(mul(a1, l2), mul(a2, l1))),
-        add(mul(a0, l1), xi(mul(a2, l2))),
-        add(mul(a0, l2), mul(a1, l1)),
+        xi(add(p[9], p[10])),
+        add(p[11], xi(p[12])),
+        add(p[13], p[14]),
     )
-    b_l0 = (mul(b0, l0), mul(b1, l0), mul(b2, l0))
+    b_l0 = (p[15], p[16], p[17])
     c1 = tuple(add(x, y) for x, y in zip(a_l1, b_l0))
     return (c0, c1)
 
@@ -78,53 +87,93 @@ def fp12_mul_sparse_line(ctx, f, l0, l1, l2):
 
 
 def _dbl_step(ctx, t, xp, yp):
-    """Double T and return the tangent line at P=(xp, yp) (batched Fp)."""
-    mul = functools.partial(T.fp2_mul, ctx)
-    sqr = functools.partial(T.fp2_sqr, ctx)
+    """Double T and return the tangent line at P=(xp, yp) (batched Fp).
+
+    Three stacked levels (spec: pairing_fast.py:120 — identical algebra)."""
     sub = functools.partial(T.fp2_sub, ctx)
     small = functools.partial(T.fp2_small, ctx)
-    mul_fp = functools.partial(T.fp2_mul_fp, ctx)
 
     x, y, z = t
-    w = small(sqr(x), 3)
-    s = mul(y, z)
-    bb = mul(mul(x, y), s)
-    h = sub(sqr(w), small(bb, 8))
-    y2 = sqr(y)
+    xx, y2, s, xy = T.fp2_batch(
+        ctx, [("sqr", x), ("sqr", y), ("mul", y, z), ("mul", x, y)]
+    )
+    w = small(xx, 3)
 
-    x3 = small(mul(h, s), 2)
-    y3 = sub(mul(w, sub(small(bb, 4), h)), small(mul(y2, sqr(s)), 8))
-    z3 = small(mul(s, sqr(s)), 8)
+    w2, bb, ss, sz, y2z, wx, wz = T.fp2_batch(
+        ctx,
+        [
+            ("sqr", w),
+            ("mul", xy, s),
+            ("sqr", s),
+            ("mul", s, z),
+            ("mul", y2, z),
+            ("mul", w, x),
+            ("mul", w, z),
+        ],
+    )
+    h = sub(w2, small(bb, 8))
 
     two_yp = limb.double_mod(ctx, yp)
-    l0 = T.fp2_mul_xi(ctx, mul_fp(mul(s, z), two_yp))
-    l1 = sub(mul(w, x), small(mul(y2, z), 2))
-    l2 = mul_fp(mul(w, z), limb.neg_mod(ctx, xp))
+    hs, wb, y2ss, sss, l0raw, l2 = T.fp2_batch(
+        ctx,
+        [
+            ("mul", h, s),
+            ("mul", w, sub(small(bb, 4), h)),
+            ("mul", y2, ss),
+            ("mul", s, ss),
+            ("mul_fp", sz, two_yp),
+            ("mul_fp", wz, limb.neg_mod(ctx, xp)),
+        ],
+    )
+    x3 = T.fp2_double(ctx, hs)
+    y3 = sub(wb, small(y2ss, 8))
+    z3 = small(sss, 8)
+    l0 = T.fp2_mul_xi(ctx, l0raw)
+    l1 = sub(wx, T.fp2_double(ctx, y2z))
     return (x3, y3, z3), (l0, l1, l2)
 
 
 def _add_step(ctx, t, q, xp, yp):
-    """Mixed add T + affine Q; chord line at P=(xp, yp)."""
-    mul = functools.partial(T.fp2_mul, ctx)
-    sqr = functools.partial(T.fp2_sqr, ctx)
+    """Mixed add T + affine Q; chord line at P=(xp, yp). Four stacked
+    levels (spec: pairing_fast.py:149 — identical algebra)."""
     sub = functools.partial(T.fp2_sub, ctx)
     add = functools.partial(T.fp2_add, ctx)
-    mul_fp = functools.partial(T.fp2_mul_fp, ctx)
 
     x, y, z = t
     x2, y2 = q
-    theta = sub(y, mul(y2, z))
-    lam = sub(x, mul(x2, z))
-    lam2 = sqr(lam)
-    lam3 = mul(lam2, lam)
-    ww = add(sub(mul(sqr(theta), z), mul(lam2, T.fp2_double(ctx, x))), lam3)
-    x3 = mul(lam, ww)
-    y3 = sub(mul(theta, sub(mul(lam2, x), ww)), mul(lam3, y))
-    z3 = mul(lam3, z)
+    y2z, x2z = T.fp2_mul_many(ctx, [(y2, z), (x2, z)])
+    theta = sub(y, y2z)
+    lam = sub(x, x2z)
 
-    l0 = T.fp2_mul_xi(ctx, mul_fp(lam, yp))
-    l1 = sub(mul(theta, x2), mul(lam, y2))
-    l2 = mul_fp(theta, limb.neg_mod(ctx, xp))
+    lam2, theta2, tx2, ly2, l0raw, l2 = T.fp2_batch(
+        ctx,
+        [
+            ("sqr", lam),
+            ("sqr", theta),
+            ("mul", theta, x2),
+            ("mul", lam, y2),
+            ("mul_fp", lam, yp),
+            ("mul_fp", theta, limb.neg_mod(ctx, xp)),
+        ],
+    )
+    l0 = T.fp2_mul_xi(ctx, l0raw)
+    l1 = sub(tx2, ly2)
+
+    lam3, theta2z, lam2x = T.fp2_mul_many(
+        ctx, [(lam2, lam), (theta2, z), (lam2, x)]
+    )
+    ww = add(sub(theta2z, T.fp2_double(ctx, lam2x)), lam3)
+
+    x3, tt, lam3y, z3 = T.fp2_batch(
+        ctx,
+        [
+            ("mul", lam, ww),
+            ("mul", theta, sub(lam2x, ww)),
+            ("mul", lam3, y),
+            ("mul", lam3, z),
+        ],
+    )
+    y3 = sub(tt, lam3y)
     return (x3, y3, z3), (l0, l1, l2)
 
 

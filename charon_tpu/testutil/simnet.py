@@ -67,6 +67,7 @@ def build_cluster(
     slot_duration: float = 0.2,
     slots_per_epoch: int = 8,
     genesis_time: float | None = None,
+    use_qbft: bool = False,
 ) -> SimCluster:
     """Create keys and wire n in-process nodes (ref: app/app.go simnet +
     cluster/test_cluster.go generator, redesigned for asyncio)."""
@@ -109,15 +110,24 @@ def build_cluster(
     )
 
     transport = MemTransport()
+    qbft_net = None
+    if use_qbft:
+        from charon_tpu.core.consensus_qbft import MemMsgNet
+
+        qbft_net = MemMsgNet()
     for i in range(1, n + 1):
         cluster.nodes.append(
-            _build_node(cluster, i, transport, slots_per_epoch)
+            _build_node(cluster, i, transport, slots_per_epoch, qbft_net)
         )
     return cluster
 
 
 def _build_node(
-    cluster: SimCluster, share_idx: int, transport: MemTransport, spe: int
+    cluster: SimCluster,
+    share_idx: int,
+    transport: MemTransport,
+    spe: int,
+    qbft_net=None,
 ) -> SimNode:
     beacon = cluster.beacon
     fork = cluster.fork
@@ -128,7 +138,14 @@ def _build_node(
     aggsigdb = AggSigDB()
     bcast = Broadcaster(beacon=beacon, clock=beacon.clock())
     fetcher = Fetcher(beacon)
-    consensus = ConsensusController(EchoConsensus())
+    if qbft_net is not None:
+        from charon_tpu.core.consensus_qbft import QBFTConsensus
+
+        consensus = ConsensusController(
+            QBFTConsensus(qbft_net, cluster.n, round_timeout=0.3)
+        )
+    else:
+        consensus = ConsensusController(EchoConsensus())
     vapi = ValidatorAPI(
         share_idx=share_idx,
         pubshares=cluster.pubshares_by_idx[share_idx],

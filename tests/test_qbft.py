@@ -1,0 +1,163 @@
+"""QBFT engine: agreement, validity, leader-failure round changes.
+
+Deterministic-simulation style tests (the reference drives its pure engine
+the same way, ref: core/qbft/qbft_test.go approach — in-memory transports,
+no real network)."""
+
+import asyncio
+import random
+
+import pytest
+
+from charon_tpu.core import qbft
+
+
+class Net:
+    """In-memory broadcast network with optional per-sender drop rules."""
+
+    def __init__(self, n, drop=None, delay=None):
+        self.transports = []
+        self.drop = drop or (lambda src, dst, msg: False)
+        self.delay = delay
+        for i in range(n):
+            self.transports.append(qbft.Transport(self._make_bcast(i)))
+
+    def _make_bcast(self, src):
+        async def bcast(msg):
+            for dst, tr in enumerate(self.transports):
+                if dst == src:
+                    continue  # engine loopback handles self-delivery
+                if self.drop(src, dst, msg):
+                    continue
+                if self.delay:
+                    asyncio.get_running_loop().call_later(
+                        self.delay(src, dst), tr.inbox.put_nowait, msg
+                    )
+                else:
+                    tr.inbox.put_nowait(msg)
+
+        return bcast
+
+
+def make_defn(n, timeout=0.15):
+    return qbft.Definition(
+        nodes=n,
+        leader=lambda inst, rnd: (hash(inst) + rnd) % n,
+        timeout=lambda r: timeout * r,
+    )
+
+
+async def run_cluster(n, values, drop=None, delay=None, timeout=5.0):
+    net = Net(n, drop=drop, delay=delay)
+    defn = make_defn(n)
+    tasks = [
+        asyncio.create_task(
+            qbft.run(defn, net.transports[i], "duty-1", i, values[i])
+        )
+        for i in range(n)
+    ]
+    done = await asyncio.wait_for(asyncio.gather(*tasks), timeout)
+    return done
+
+
+def test_happy_path_agreement():
+    async def run():
+        decided = await run_cluster(4, [f"v{i}" for i in range(4)])
+        # agreement: all decide the same value
+        assert len(set(decided)) == 1
+        # validity: the leader of round 1 proposed its own value
+        leader = make_defn(4).leader("duty-1", 1)
+        assert decided[0] == f"v{leader}"
+
+    asyncio.run(run())
+
+
+def test_agreement_with_message_delays():
+    rng = random.Random(3)
+
+    async def run():
+        decided = await run_cluster(
+            4,
+            [f"v{i}" for i in range(4)],
+            delay=lambda s, d: rng.uniform(0, 0.05),
+        )
+        assert len(set(decided)) == 1
+
+    asyncio.run(run())
+
+
+def test_leader_failure_triggers_round_change():
+    async def run():
+        leader1 = make_defn(4).leader("duty-1", 1)
+
+        # drop EVERYTHING the round-1 leader sends: the cluster must rotate
+        # to round 2 and decide the round-2 leader's value.
+        def drop(src, dst, msg):
+            return src == leader1
+
+        values = [f"v{i}" for i in range(4)]
+        net = Net(4, drop=drop)
+        defn = make_defn(4)
+        tasks = [
+            asyncio.create_task(
+                qbft.run(defn, net.transports[i], "duty-1", i, values[i])
+            )
+            for i in range(4)
+            if i != leader1  # the crashed leader doesn't participate
+        ]
+        decided = await asyncio.wait_for(asyncio.gather(*tasks), 10)
+        assert len(set(decided)) == 1
+        leader2 = defn.leader("duty-1", 2)
+        assert decided[0] == f"v{leader2}"
+
+    asyncio.run(run())
+
+
+def test_seven_nodes_two_silent():
+    async def run():
+        n = 7
+        silent = {5, 6}
+
+        def drop(src, dst, msg):
+            return src in silent
+
+        values = [f"v{i}" for i in range(n)]
+        net = Net(n, drop=drop)
+        defn = make_defn(n)
+        tasks = [
+            asyncio.create_task(
+                qbft.run(defn, net.transports[i], "d", i, values[i])
+            )
+            for i in range(n)
+            if i not in silent
+        ]
+        decided = await asyncio.wait_for(asyncio.gather(*tasks), 10)
+        assert len(set(decided)) == 1
+
+    asyncio.run(run())
+
+
+def test_late_value_via_future():
+    """Participate-then-propose: the leader's value arrives after start
+    (ref: core/consensus/qbft Propose vs Participate split)."""
+
+    async def run():
+        n = 4
+        net = Net(n)
+        defn = make_defn(n)
+        leader = defn.leader("d", 1)
+        loop = asyncio.get_running_loop()
+        futs = {i: loop.create_future() for i in range(n)}
+        tasks = [
+            asyncio.create_task(
+                qbft.run(defn, net.transports[i], "d", i, None, futs[i])
+            )
+            for i in range(n)
+        ]
+        await asyncio.sleep(0.05)
+        for i in range(n):
+            futs[i].set_result(f"v{i}")
+        decided = await asyncio.wait_for(asyncio.gather(*tasks), 10)
+        assert set(decided) == {f"v{leader}"}
+
+    asyncio.run(run())

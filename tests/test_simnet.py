@@ -2,8 +2,8 @@
 and every node broadcasts the same valid group signature.
 
 Mirrors ref: testutil/integration/simnet_test.go:49-130 (attester flow with
-beaconmock + validatormock), with the echo consensus stub standing in for
-QBFT (SURVEY.md §7 minimum slice).
+beaconmock + validatormock), once with the echo consensus stub and once
+with real QBFT consensus.
 """
 
 import asyncio
@@ -23,36 +23,51 @@ def python_tbls():
     yield
 
 
+async def _drive_and_check(cluster):
+    tasks = [
+        asyncio.create_task(node.scheduler.run()) for node in cluster.nodes
+    ]
+    beacon = cluster.beacon
+    try:
+
+        async def all_done():
+            while len(beacon.attestations) < 4:
+                await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(all_done(), timeout=60)
+    finally:
+        for node in cluster.nodes:
+            node.scheduler.stop()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    atts = beacon.attestations[:4]
+    # all nodes recovered the SAME group signature
+    sigs = {a.signature for a in atts}
+    assert len(sigs) == 1
+    # and it verifies under the group public key
+    att = atts[0]
+    group_pk = cluster.group_pubkeys[0]
+    root = SignedData("attestation", att).signing_root(
+        cluster.fork, att.data.slot // beacon.slots_per_epoch
+    )
+    tbls.verify(pubkey_to_bytes(group_pk), root, att.signature)
+
+
 def test_simnet_attestation_flow():
     async def run():
         cluster = build_cluster(n=4, t=3, num_validators=1, slot_duration=0.4)
-        tasks = [
-            asyncio.create_task(node.scheduler.run())
-            for node in cluster.nodes
-        ]
-        beacon = cluster.beacon
-        try:
-            # run until every node broadcast at least one attestation
-            async def all_done():
-                while len(beacon.attestations) < 4:
-                    await asyncio.sleep(0.05)
+        await _drive_and_check(cluster)
 
-            await asyncio.wait_for(all_done(), timeout=30)
-        finally:
-            for node in cluster.nodes:
-                node.scheduler.stop()
-            await asyncio.gather(*tasks, return_exceptions=True)
+    asyncio.run(run())
 
-        atts = beacon.attestations[:4]
-        # all nodes recovered the SAME group signature
-        sigs = {a.signature for a in atts}
-        assert len(sigs) == 1
-        # and it verifies under the group public key
-        att = atts[0]
-        group_pk = cluster.group_pubkeys[0]
-        root = SignedData("attestation", att).signing_root(
-            cluster.fork, att.data.slot // beacon.slots_per_epoch
+
+def test_simnet_attestation_flow_qbft():
+    """Same flow with real QBFT consensus instead of the echo stub."""
+
+    async def run():
+        cluster = build_cluster(
+            n=4, t=3, num_validators=1, slot_duration=0.8, use_qbft=True
         )
-        tbls.verify(pubkey_to_bytes(group_pk), root, att.signature)
+        await _drive_and_check(cluster)
 
     asyncio.run(run())
