@@ -1,0 +1,7 @@
+"""App infrastructure: wiring, lifecycle, logging, retries, health.
+
+Mirrors the reference's app layer (ref: app/ — lifecycle manager, log/z,
+errors, retry, featureset, health, promauto, monitoring API) in asyncio
+Python. The run() entry point (app/run.py) wires every component the way
+ref app/app.go:131 does.
+"""

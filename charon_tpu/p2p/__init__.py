@@ -1,0 +1,11 @@
+"""Peer networking: asyncio TCP mesh with typed messages.
+
+Mirrors ref: p2p/ (libp2p TCP host, typed request/response streams,
+connection gating to cluster peers, continuous ping — p2p/p2p.go:36,
+p2p/sender.go, p2p/gater.go, p2p/ping.go) re-designed on asyncio: one
+length-prefixed TCP connection per peer pair, protocol-tagged frames
+dispatched to registered handlers, secp256k1-authenticated handshake.
+"""
+
+from charon_tpu.p2p.codec import decode, encode, register  # noqa: F401
+from charon_tpu.p2p.transport import P2PNode, PeerSpec  # noqa: F401

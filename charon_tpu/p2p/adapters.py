@@ -1,0 +1,62 @@
+"""TCP-backed transports for the workflow components.
+
+These adapt the P2PNode mesh to the transport interfaces the in-memory
+simnet fakes implement, so the same ParSigEx / QBFTConsensus components run
+over real sockets (ref: the reference's parsigex protocol
+/charon/parsigex/2.0.0 — p2p/parsigex.go:23 — and consensus transport
+core/consensus/qbft/transport.go).
+"""
+
+from __future__ import annotations
+
+from charon_tpu.p2p.transport import P2PNode
+
+PARSIGEX_PROTOCOL = "parsigex/2.0.0"
+QBFT_PROTOCOL = "qbft/2.0.0"
+
+
+class TcpParSigTransport:
+    """Drop-in for core.parsigex.MemTransport over the TCP mesh.
+
+    Node indices are 0-based; share indices 1-based (idx = share-1)."""
+
+    def __init__(self, node: P2PNode) -> None:
+        self.node = node
+        self.local = None
+        node.register_handler(PARSIGEX_PROTOCOL, self._on_msg)
+
+    def attach(self, parsigex) -> None:
+        self.local = parsigex
+
+    async def send(self, from_share_idx: int, duty, signed_set) -> None:
+        await self.node.broadcast(
+            PARSIGEX_PROTOCOL, {"duty": duty, "set": signed_set}
+        )
+
+    async def _on_msg(self, from_idx: int, msg):
+        if self.local is not None:
+            await self.local.receive(msg["duty"], msg["set"])
+        return None
+
+
+class TcpQbftNet:
+    """Drop-in for core.consensus_qbft.MemMsgNet over the TCP mesh."""
+
+    def __init__(self, node: P2PNode) -> None:
+        self.node = node
+        self.local = None
+        node.register_handler(QBFT_PROTOCOL, self._on_msg)
+
+    def attach(self, consensus) -> int:
+        self.local = consensus
+        return self.node.index
+
+    async def broadcast(self, from_idx: int, duty, msg, values) -> None:
+        await self.node.broadcast(
+            QBFT_PROTOCOL, {"duty": duty, "msg": msg, "vals": values}
+        )
+
+    async def _on_msg(self, from_idx: int, m):
+        if self.local is not None:
+            self.local.deliver(m["duty"], m["msg"], m["vals"])
+        return None

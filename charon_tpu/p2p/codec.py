@@ -1,0 +1,122 @@
+"""Typed wire codec for the framework's frozen-dataclass messages.
+
+The reference frames delimited protobufs over libp2p streams
+(ref: p2p/sender.go protobuf framing); this framework's wire format is a
+self-describing JSON encoding of its registered dataclasses — bytes as
+hex, enums as ints, tuples as lists, nested dataclasses tagged with their
+registered type name. Untrusted input is decoded only into *registered*
+types with field filtering (never pickle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Type
+
+_REGISTRY: dict[str, Type] = {}
+
+
+def register(cls: Type) -> Type:
+    """Register a dataclass for wire transport (decorator-friendly)."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _to_jsonable(v: Any) -> Any:
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        name = type(v).__name__
+        if name not in _REGISTRY:
+            raise TypeError(f"unregistered dataclass {name}")
+        out = {"__t": name}
+        for f in dataclasses.fields(v):
+            out[f.name] = _to_jsonable(getattr(v, f.name))
+        return out
+    if isinstance(v, enum.Enum):
+        return {"__e": type(v).__name__, "v": v.value}
+    if isinstance(v, bytes):
+        return {"__b": v.hex()}
+    if isinstance(v, (tuple, list)):
+        return {"__l": [_to_jsonable(x) for x in v]}
+    if isinstance(v, dict):
+        return {"__d": [[_to_jsonable(k), _to_jsonable(x)] for k, x in v.items()]}
+    if v is None or isinstance(v, (str, int, float, bool)):
+        return v
+    raise TypeError(f"cannot encode {type(v)}")
+
+
+_ENUMS: dict[str, Type] = {}
+
+
+def register_enum(cls: Type) -> Type:
+    _ENUMS[cls.__name__] = cls
+    return cls
+
+
+def _from_jsonable(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__t" in v:
+            cls = _REGISTRY.get(v["__t"])
+            if cls is None:
+                raise ValueError(f"unknown wire type {v['__t']}")
+            kwargs = {
+                f.name: _from_jsonable(v[f.name])
+                for f in dataclasses.fields(cls)
+                if f.name in v
+            }
+            return cls(**kwargs)
+        if "__e" in v:
+            cls = _ENUMS.get(v["__e"])
+            if cls is None:
+                raise ValueError(f"unknown enum {v['__e']}")
+            return cls(v["v"])
+        if "__b" in v:
+            return bytes.fromhex(v["__b"])
+        if "__l" in v:
+            return tuple(_from_jsonable(x) for x in v["__l"])
+        if "__d" in v:
+            return {
+                _from_jsonable(k): _from_jsonable(x) for k, x in v["__d"]
+            }
+    return v
+
+
+def encode(msg: Any) -> bytes:
+    return json.dumps(_to_jsonable(msg), separators=(",", ":")).encode()
+
+
+def decode(data: bytes) -> Any:
+    return _from_jsonable(json.loads(data.decode()))
+
+
+def _register_core_types() -> None:
+    from charon_tpu.core import eth2data as d
+    from charon_tpu.core import qbft
+    from charon_tpu.core.types import Duty, DutyType
+
+    for cls in (
+        Duty,
+        d.Checkpoint,
+        d.AttestationData,
+        d.Attestation,
+        d.BeaconBlockHeader,
+        d.Proposal,
+        d.AggregateAndProof,
+        d.SyncCommitteeMessage,
+        d.SyncCommitteeContribution,
+        d.ContributionAndProof,
+        d.ValidatorRegistration,
+        d.VoluntaryExit,
+        d.AttestationDuty,
+        d.SignedData,
+        d.ParSignedData,
+        d.SyncSelectionData,
+        qbft.Msg,
+    ):
+        register(cls)
+    register_enum(DutyType)
+    register_enum(qbft.MsgType)
+
+
+_register_core_types()

@@ -1,0 +1,277 @@
+"""Asyncio TCP peer mesh: authenticated, gated, typed request/response.
+
+Mirrors ref: p2p/ —
+  * NewTCPNode (p2p/p2p.go:36): here one asyncio TCP server per node plus
+    one outbound connection per peer, lazily dialed with backoff;
+  * conn gater (p2p/gater.go:16): the handshake proves possession of the
+    peer's registered secp256k1 key; unknown keys are dropped;
+  * Sender.SendAsync/SendReceive (p2p/sender.go:90): protocol-tagged
+    frames with request ids, send/receive timeouts, per-peer failure
+    hysteresis to suppress log storms (sender.go:85-110);
+  * RegisterHandler (p2p/receive.go:40): async handler per protocol id;
+  * ping (p2p/ping.go): continuous keepalive feeding peer-health state.
+
+Frame format: 4-byte big-endian length, then JSON envelope
+{"p": protocol, "id": reqid, "k": "req"|"rsp", "d": codec payload}.
+Max frame 128 MB and 5s/7s recv/send timeouts follow the reference's
+envelope (p2p/sender.go:23-29).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from charon_tpu.app import k1util
+from charon_tpu.p2p import codec
+
+MAX_FRAME = 128 * 1024 * 1024  # ref: p2p/sender.go:26
+SEND_TIMEOUT = 7.0  # ref: p2p/sender.go:28
+RECV_TIMEOUT = 5.0  # ref: p2p/sender.go:27
+HYSTERESIS_FAILS = 3  # suppress errors after this many consecutive fails
+
+
+@dataclass(frozen=True)
+class PeerSpec:
+    index: int
+    pubkey: bytes  # 33-byte compressed secp256k1
+    host: str
+    port: int
+
+
+class HandshakeError(Exception):
+    pass
+
+
+@dataclass
+class _Conn:
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+    peer_idx: int
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+
+class P2PNode:
+    def __init__(
+        self,
+        index: int,
+        privkey,
+        peers: list[PeerSpec],
+        cluster_hash: bytes,
+    ) -> None:
+        self.index = index
+        self.key = privkey
+        self.peers = {p.index: p for p in peers if p.index != index}
+        self.self_spec = next(p for p in peers if p.index == index)
+        self.cluster_hash = cluster_hash
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: dict[int, _Conn] = {}
+        self._handlers: dict[str, Callable] = {}
+        self._pending: dict[str, asyncio.Future] = {}
+        self._fail_counts: dict[int, int] = {}
+        self._ping_task: asyncio.Task | None = None
+        self.ping_success: dict[int, bool] = {}
+        self._recv_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.self_spec.host, self.self_spec.port
+        )
+        self.register_handler("ping", self._handle_ping)
+
+    async def stop(self) -> None:
+        if self._ping_task:
+            self._ping_task.cancel()
+        for task in list(self._recv_tasks):
+            task.cancel()
+        for conn in list(self._conns.values()):
+            conn.writer.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    def register_handler(self, protocol: str, handler) -> None:
+        """ref: p2p/receive.go:40 RegisterHandler."""
+        self._handlers[protocol] = handler
+
+    # -- handshake --------------------------------------------------------
+
+    def _hello_digest(self, idx: int, nonce: bytes) -> bytes:
+        return hashlib.sha256(
+            b"charon-tpu-hello" + self.cluster_hash + idx.to_bytes(4, "big") + nonce
+        ).digest()
+
+    async def _on_inbound(self, reader, writer) -> None:
+        try:
+            nonce = os.urandom(16)
+            writer.write(nonce)
+            await writer.drain()
+            hello = await asyncio.wait_for(
+                _read_frame(reader), RECV_TIMEOUT
+            )
+            h = json.loads(hello)
+            idx = h["idx"]
+            peer = self.peers.get(idx)
+            # conn gater: only registered cluster peers may connect
+            # (ref: p2p/gater.go:16-77)
+            if peer is None:
+                raise HandshakeError(f"unknown peer index {idx}")
+            sig = bytes.fromhex(h["sig"])
+            if not k1util.verify_bytes(
+                peer.pubkey, self._hello_digest(idx, nonce), sig
+            ):
+                raise HandshakeError(f"bad handshake signature from {idx}")
+        except (HandshakeError, Exception):
+            writer.close()
+            return
+        conn = _Conn(reader, writer, idx)
+        self._conns.setdefault(idx, conn)
+        self._spawn_recv(conn)
+
+    async def _dial(self, peer: PeerSpec) -> _Conn:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(peer.host, peer.port), SEND_TIMEOUT
+        )
+        nonce = await asyncio.wait_for(reader.readexactly(16), RECV_TIMEOUT)
+        sig = k1util.sign(self.key, self._hello_digest(self.index, nonce))
+        _write_frame(
+            writer,
+            json.dumps({"idx": self.index, "sig": sig.hex()}).encode(),
+        )
+        await writer.drain()
+        conn = _Conn(reader, writer, peer.index)
+        self._spawn_recv(conn)
+        return conn
+
+    async def _get_conn(self, peer_idx: int) -> _Conn:
+        conn = self._conns.get(peer_idx)
+        if conn is not None and not conn.writer.is_closing():
+            return conn
+        peer = self.peers[peer_idx]
+        conn = await self._dial(peer)
+        self._conns[peer_idx] = conn
+        return conn
+
+    # -- send -------------------------------------------------------------
+
+    async def send(self, peer_idx: int, protocol: str, msg, await_response: bool = False):
+        """SendAsync / SendReceive (ref: p2p/sender.go:90-95)."""
+        req_id = os.urandom(8).hex()
+        envelope = {
+            "p": protocol,
+            "id": req_id,
+            "k": "req",
+            "s": self.index,
+            "d": codec._to_jsonable(msg) if msg is not None else None,
+        }
+        fut = None
+        if await_response:
+            fut = asyncio.get_running_loop().create_future()
+            self._pending[req_id] = fut
+        try:
+            conn = await self._get_conn(peer_idx)
+            async with conn.lock:
+                _write_frame(conn.writer, json.dumps(envelope).encode())
+                await asyncio.wait_for(conn.writer.drain(), SEND_TIMEOUT)
+            self._fail_counts[peer_idx] = 0
+            if fut is not None:
+                return await asyncio.wait_for(fut, RECV_TIMEOUT)
+            return None
+        except Exception:
+            # hysteresis: count failures, drop the dead connection
+            self._fail_counts[peer_idx] = self._fail_counts.get(peer_idx, 0) + 1
+            self._conns.pop(peer_idx, None)
+            if fut is not None:
+                self._pending.pop(req_id, None)
+            raise
+
+    def peer_failing(self, peer_idx: int) -> bool:
+        return self._fail_counts.get(peer_idx, 0) >= HYSTERESIS_FAILS
+
+    async def broadcast(self, protocol: str, msg) -> None:
+        """Fire-and-forget to every peer; failures are independent."""
+        results = await asyncio.gather(
+            *(
+                self.send(idx, protocol, msg)
+                for idx in self.peers
+            ),
+            return_exceptions=True,
+        )
+        del results  # individual failures surface via hysteresis state
+
+    # -- receive ----------------------------------------------------------
+
+    def _spawn_recv(self, conn: _Conn) -> None:
+        task = asyncio.create_task(self._recv_loop(conn))
+        self._recv_tasks.add(task)
+        task.add_done_callback(self._recv_tasks.discard)
+
+    async def _recv_loop(self, conn: _Conn) -> None:
+        try:
+            while True:
+                frame = await _read_frame(conn.reader)
+                env = json.loads(frame)
+                if env["k"] == "rsp":
+                    fut = self._pending.pop(env["id"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(codec._from_jsonable(env["d"]))
+                    continue
+                handler = self._handlers.get(env["p"])
+                if handler is None:
+                    continue
+                msg = codec._from_jsonable(env["d"]) if env["d"] is not None else None
+                resp = await handler(env.get("s", conn.peer_idx), msg)
+                if resp is not None:
+                    out = {
+                        "p": env["p"],
+                        "id": env["id"],
+                        "k": "rsp",
+                        "d": codec._to_jsonable(resp),
+                    }
+                    async with conn.lock:
+                        _write_frame(conn.writer, json.dumps(out).encode())
+                        await conn.writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._conns.pop(conn.peer_idx, None)
+            conn.writer.close()
+
+    # -- ping (ref: p2p/ping.go:35) ---------------------------------------
+
+    async def _handle_ping(self, from_idx: int, msg):
+        return {"pong": self.index}
+
+    def start_ping(self, interval: float = 1.0) -> None:
+        async def loop():
+            while True:
+                for idx in self.peers:
+                    try:
+                        await self.send(idx, "ping", None, await_response=True)
+                        self.ping_success[idx] = True
+                    except Exception:
+                        self.ping_success[idx] = False
+                await asyncio.sleep(interval)
+
+        self._ping_task = asyncio.create_task(loop())
+
+
+def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    if len(payload) > MAX_FRAME:
+        raise ValueError("frame exceeds max size")
+    writer.write(len(payload).to_bytes(4, "big") + payload)
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(4)
+    length = int.from_bytes(header, "big")
+    if length > MAX_FRAME:
+        raise ConnectionError("oversized frame")
+    return await reader.readexactly(length)
