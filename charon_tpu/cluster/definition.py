@@ -1,0 +1,174 @@
+"""cluster-definition.json: the intended cluster configuration.
+
+Mirrors ref: cluster/definition.go — operators agree on (name, validators,
+threshold, fork) before DKG; each operator signs the config hash and their
+ENR with their secp256k1 key (the reference uses EIP-712 typed signing;
+here the signed payload is the canonical-JSON config hash domain-tagged,
+same authorization semantics).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import uuid as uuidlib
+from dataclasses import asdict, dataclass, field, replace
+
+from charon_tpu.app import k1util
+
+DEFINITION_VERSION = "ctpu/v1.0"
+_CONFIG_DOMAIN = b"charon-tpu/definition-config-hash"
+_ENR_DOMAIN = b"charon-tpu/operator-enr"
+
+
+def _canonical(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+@dataclass(frozen=True)
+class Operator:
+    """One node operator (ref: cluster/definition.go Operator)."""
+
+    address: str  # operator identity (eth address or label)
+    enr: str  # node record (charon_tpu/eth2util/enr format)
+    config_signature: str = ""  # hex k1 sig over the config hash
+    enr_signature: str = ""  # hex k1 sig over the ENR
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+@dataclass(frozen=True)
+class ClusterDefinition:
+    name: str
+    num_validators: int
+    threshold: int
+    fork_version: str  # 0x-hex 4 bytes
+    operators: tuple[Operator, ...]
+    uuid: str = field(default_factory=lambda: str(uuidlib.uuid4()))
+    version: str = DEFINITION_VERSION
+    timestamp: str = ""
+    fee_recipient_address: str = ""
+    withdrawal_address: str = ""
+    dkg_algorithm: str = "frost"
+    creator_address: str = ""
+
+    # -- hashing ----------------------------------------------------------
+
+    def config_payload(self) -> dict:
+        """The operator-agnostic config (what everyone signs) —
+        ref: definition.go config hash covers all fields except
+        signatures."""
+        return {
+            "name": self.name,
+            "uuid": self.uuid,
+            "version": self.version,
+            "timestamp": self.timestamp,
+            "num_validators": self.num_validators,
+            "threshold": self.threshold,
+            "fork_version": self.fork_version,
+            "fee_recipient_address": self.fee_recipient_address,
+            "withdrawal_address": self.withdrawal_address,
+            "dkg_algorithm": self.dkg_algorithm,
+            "creator_address": self.creator_address,
+            "operators": [
+                {"address": op.address, "enr": op.enr}
+                for op in self.operators
+            ],
+        }
+
+    def config_hash(self) -> bytes:
+        return hashlib.sha256(
+            _CONFIG_DOMAIN + _canonical(self.config_payload())
+        ).digest()
+
+    def definition_hash(self) -> bytes:
+        """Hash over everything incl. signatures (the DKG context id —
+        ref: definition.go DefinitionHash)."""
+        payload = self.config_payload()
+        payload["signatures"] = [
+            {
+                "config_signature": op.config_signature,
+                "enr_signature": op.enr_signature,
+            }
+            for op in self.operators
+        ]
+        return hashlib.sha256(_CONFIG_DOMAIN + _canonical(payload)).digest()
+
+    # -- signing ----------------------------------------------------------
+
+    def sign_operator(self, op_index: int, privkey) -> "ClusterDefinition":
+        """Operator signs config hash + their ENR (ref: EIP-712 sigs,
+        cluster/eip712sigs.go)."""
+        op = self.operators[op_index]
+        cfg_sig = k1util.sign(privkey, self.config_hash())
+        enr_digest = hashlib.sha256(_ENR_DOMAIN + op.enr.encode()).digest()
+        enr_sig = k1util.sign(privkey, enr_digest)
+        new_op = replace(
+            op,
+            config_signature=cfg_sig.hex(),
+            enr_signature=enr_sig.hex(),
+        )
+        ops = list(self.operators)
+        ops[op_index] = new_op
+        return replace(self, operators=tuple(ops))
+
+    def verify_signatures(self, pubkeys: list[bytes]) -> None:
+        """pubkeys: 33-byte compressed k1 key per operator."""
+        if len(pubkeys) != len(self.operators):
+            raise ValueError("pubkey count mismatch")
+        cfg_hash = self.config_hash()
+        for op, pk in zip(self.operators, pubkeys):
+            if not op.config_signature or not op.enr_signature:
+                raise ValueError(f"operator {op.address} has not signed")
+            if not k1util.verify_bytes(
+                pk, cfg_hash, bytes.fromhex(op.config_signature)
+            ):
+                raise ValueError(f"bad config signature for {op.address}")
+            enr_digest = hashlib.sha256(
+                _ENR_DOMAIN + op.enr.encode()
+            ).digest()
+            if not k1util.verify_bytes(
+                pk, enr_digest, bytes.fromhex(op.enr_signature)
+            ):
+                raise ValueError(f"bad ENR signature for {op.address}")
+
+    # -- JSON round-trip --------------------------------------------------
+
+    def to_json(self) -> dict:
+        out = self.config_payload()
+        out["operators"] = [op.to_json() for op in self.operators]
+        out["config_hash"] = "0x" + self.config_hash().hex()
+        out["definition_hash"] = "0x" + self.definition_hash().hex()
+        return out
+
+    @classmethod
+    def from_json(cls, data: dict) -> "ClusterDefinition":
+        ops = tuple(
+            Operator(
+                address=o["address"],
+                enr=o["enr"],
+                config_signature=o.get("config_signature", ""),
+                enr_signature=o.get("enr_signature", ""),
+            )
+            for o in data["operators"]
+        )
+        defn = cls(
+            name=data["name"],
+            num_validators=data["num_validators"],
+            threshold=data["threshold"],
+            fork_version=data["fork_version"],
+            operators=ops,
+            uuid=data["uuid"],
+            version=data.get("version", DEFINITION_VERSION),
+            timestamp=data.get("timestamp", ""),
+            fee_recipient_address=data.get("fee_recipient_address", ""),
+            withdrawal_address=data.get("withdrawal_address", ""),
+            dkg_algorithm=data.get("dkg_algorithm", "frost"),
+            creator_address=data.get("creator_address", ""),
+        )
+        if "config_hash" in data:
+            want = bytes.fromhex(data["config_hash"][2:])
+            if want != defn.config_hash():
+                raise ValueError("config hash mismatch")
+        return defn

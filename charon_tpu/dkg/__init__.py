@@ -1,0 +1,8 @@
+"""Distributed key generation: FROST 2-round ceremonies.
+
+Mirrors ref: dkg/ — ceremony orchestration (dkg/dkg.go:82), the FROST
+round structure (dkg/frost.go:50-85 runs numValidators ceremonies in
+lockstep sharing two transport rounds), pre-ceremony sync, and lock /
+keystore outputs. The share-verification scalar-muls — the ceremony's
+compute bulk — run batched on the device (BASELINE config 4).
+"""

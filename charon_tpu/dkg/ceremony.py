@@ -1,0 +1,154 @@
+"""DKG ceremony orchestration: definition -> FROST -> signed lock + keys.
+
+Mirrors ref: dkg/dkg.go:82-200 — load + verify the signed definition, run
+the sync protocol, execute FROST, exchange partial signatures over the
+lock hash (ref: dkg/exchanger.go, sigTypes dkg.go:190-194), aggregate +
+verify, emit cluster-lock.json + EIP-2335 keystores + per-node k1
+signatures (ref: dkg/nodesigs.go, outputs dkg/disk.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass
+from pathlib import Path
+
+from charon_tpu import tbls
+from charon_tpu.app import k1util
+from charon_tpu.cluster.definition import ClusterDefinition
+from charon_tpu.cluster.lock import ClusterLock, DistributedValidator
+from charon_tpu.crypto.g1g2 import g1_to_bytes
+from charon_tpu.dkg import frost
+from charon_tpu.eth2util import keystore
+
+
+@dataclass
+class DKGResult:
+    lock: ClusterLock
+    share_secrets: list[bytes]  # this node's share key per validator (32B)
+
+
+class MemExchangeNet:
+    """Lockstep all-to-all exchange rounds keyed by tag (in-process DKG;
+    the TCP ceremony uses the p2p mesh with the same interface)."""
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self._rounds: dict[str, dict[int, object]] = {}
+        self._events: dict[str, asyncio.Event] = {}
+
+    def port(self, idx: int) -> "_Port":
+        return _Port(self, idx)
+
+
+class _Port:
+    def __init__(self, net: MemExchangeNet, idx: int) -> None:
+        self.net = net
+        self.idx = idx
+
+    async def exchange(self, tag: str, payload) -> dict[int, object]:
+        net = self.net
+        rnd = net._rounds.setdefault(tag, {})
+        ev = net._events.setdefault(tag, asyncio.Event())
+        rnd[self.idx] = payload
+        if len(rnd) == net.n:
+            ev.set()
+        await ev.wait()
+        return dict(rnd)
+
+
+async def run_dkg(
+    defn: ClusterDefinition,
+    node_idx: int,  # 0-based operator index
+    k1_privkey,
+    frost_port,
+    exchange_port,
+    engine=None,
+    data_dir: str | Path | None = None,
+) -> DKGResult:
+    """One node's side of the ceremony."""
+    n = len(defn.operators)
+    t = defn.threshold
+    v = defn.num_validators
+    share_idx = node_idx + 1  # 1-based
+
+    # 1. FROST: parallel ceremonies over two transport rounds
+    # (ceremony context binds to the definition, ref: dkg.go def hash use).
+    ctx = defn.definition_hash()
+    results = await frost.run_frost_parallel(
+        frost_port, share_idx, n, t, v, ctx, engine=engine
+    )
+
+    # 2. Build the (unsigned) lock.
+    validators = tuple(
+        DistributedValidator(
+            distributed_public_key="0x" + g1_to_bytes(r.group_pubkey).hex(),
+            public_shares=tuple(
+                "0x" + g1_to_bytes(r.pubshares[j]).hex()
+                for j in range(1, n + 1)
+            ),
+        )
+        for r in results
+    )
+    lock = ClusterLock(definition=defn, validators=validators)
+    lock_hash = lock.lock_hash()
+
+    # 3. Exchange partial signatures over the lock hash: every node signs
+    # with each validator's share key (ref: dkg/exchanger.go sigLock).
+    share_secrets = [
+        (r.secret_share % (1 << 256)).to_bytes(32, "big") for r in results
+    ]
+    my_partials = [
+        tbls.sign(share_secrets[i], lock_hash) for i in range(v)
+    ]
+    all_partials = await exchange_port.exchange(
+        "lock-sig", [s.hex() for s in my_partials]
+    )
+
+    # 4. Threshold-aggregate each validator's group signature, then
+    # BLS-aggregate across validators (ref: lock signature_aggregate).
+    group_sigs = tbls.threshold_aggregate_batch(
+        [
+            {
+                peer + 1: bytes.fromhex(all_partials[peer][i])
+                for peer in sorted(all_partials)
+            }
+            for i in range(v)
+        ]
+    )
+    sig_agg = tbls.aggregate(group_sigs)
+    tbls.verify_aggregate(
+        [bytes.fromhex(dv.distributed_public_key[2:]) for dv in validators],
+        lock_hash,
+        sig_agg,
+    )
+
+    # 5. Per-node k1 signatures over the lock hash
+    # (ref: dkg/nodesigs.go via the reliable-broadcast component).
+    my_node_sig = k1util.sign(k1_privkey, lock_hash)
+    all_node_sigs = await exchange_port.exchange(
+        "node-sig", my_node_sig.hex()
+    )
+    lock = ClusterLock(
+        definition=defn,
+        validators=validators,
+        signature_aggregate="0x" + sig_agg.hex(),
+        node_signatures=tuple(
+            all_node_sigs[i] for i in sorted(all_node_sigs)
+        ),
+    )
+
+    # 6. Outputs (ref: dkg/disk.go — lock, keystores, passwords).
+    if data_dir is not None:
+        data_dir = Path(data_dir)
+        data_dir.mkdir(parents=True, exist_ok=True)
+        lock.save(str(data_dir / "cluster-lock.json"))
+        keystore.store_keys(
+            share_secrets,
+            data_dir / "validator_keys",
+            pubkeys=[
+                dv.public_shares[node_idx] for dv in validators
+            ],
+        )
+    return DKGResult(lock=lock, share_secrets=share_secrets)

@@ -1,0 +1,139 @@
+"""Cluster formats + full DKG ceremony end-to-end: signed definition ->
+FROST -> verified lock + EIP-2335 keystores that can sign duties."""
+
+import asyncio
+import json
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.app import k1util
+from charon_tpu.cluster import ClusterDefinition, ClusterLock, Operator
+from charon_tpu.dkg import frost
+from charon_tpu.dkg.ceremony import MemExchangeNet, run_dkg
+from charon_tpu.eth2util import keystore
+from charon_tpu.tbls.python_impl import PythonImpl
+
+
+@pytest.fixture(autouse=True)
+def python_tbls():
+    tbls.set_implementation(PythonImpl())
+    yield
+
+
+def make_definition(n=3, t=2, v=2):
+    keys = [k1util.generate_private_key() for _ in range(n)]
+    ops = tuple(
+        Operator(address=f"0xop{i}", enr=f"enr:-node-{i}") for i in range(n)
+    )
+    defn = ClusterDefinition(
+        name="test-cluster",
+        num_validators=v,
+        threshold=t,
+        fork_version="0x00000000",
+        operators=ops,
+        uuid="fixed-uuid",
+        timestamp="2026-07-29T00:00:00Z",
+    )
+    for i in range(n):
+        defn = defn.sign_operator(i, keys[i])
+    return defn, keys
+
+
+def test_definition_signing_and_roundtrip():
+    defn, keys = make_definition()
+    pubs = [k1util.public_key_to_bytes(k.public_key()) for k in keys]
+    defn.verify_signatures(pubs)
+    # tamper -> verification fails
+    with pytest.raises(ValueError):
+        defn.verify_signatures(list(reversed(pubs)))
+    # JSON round-trip preserves hashes
+    again = ClusterDefinition.from_json(defn.to_json())
+    assert again.config_hash() == defn.config_hash()
+    assert again.definition_hash() == defn.definition_hash()
+
+
+def test_keystore_roundtrip(tmp_path):
+    secret = bytes(range(32))
+    ks = keystore.encrypt(secret, "hunter2", pubkey_hex="0xabcd")
+    assert keystore.decrypt(ks, "hunter2") == secret
+    with pytest.raises(ValueError):
+        keystore.decrypt(ks, "wrong")
+    keystore.store_keys([secret, secret[::-1]], tmp_path / "keys")
+    assert keystore.load_keys(tmp_path / "keys") == [secret, secret[::-1]]
+
+
+def test_full_dkg_ceremony(tmp_path):
+    n, t, v = 3, 2, 2
+    defn, keys = make_definition(n, t, v)
+
+    async def run():
+        fnet = frost.MemFrostTransport(n)
+        xnet = MemExchangeNet(n)
+        tasks = [
+            run_dkg(
+                defn,
+                i,
+                keys[i],
+                fnet.participant(i + 1),
+                xnet.port(i),
+                data_dir=tmp_path / f"node{i}",
+            )
+            for i in range(n)
+        ]
+        return await asyncio.gather(*tasks)
+
+    results = asyncio.run(run())
+
+    # all nodes produced identical locks
+    hashes = {r.lock.lock_hash() for r in results}
+    assert len(hashes) == 1
+
+    # the lock verifies: aggregate BLS sig + node k1 sigs
+    pubs = [k1util.public_key_to_bytes(k.public_key()) for k in keys]
+    results[0].lock.verify(pubs)
+
+    # lock JSON round-trips through disk
+    reloaded = ClusterLock.load(str(tmp_path / "node0" / "cluster-lock.json"))
+    assert reloaded.lock_hash() == results[0].lock.lock_hash()
+    reloaded.verify(pubs)
+
+    # keystores hold share keys that actually form the threshold key
+    shares = {
+        i + 1: keystore.load_keys(tmp_path / f"node{i}" / "validator_keys")[0]
+        for i in range(t)
+    }
+    msg = b"post-dkg duty"
+    partials = {i: tbls.sign(s, msg) for i, s in shares.items()}
+    group_sig = tbls.threshold_aggregate(partials)
+    group_pk = bytes.fromhex(
+        results[0].lock.validators[0].distributed_public_key[2:]
+    )
+    tbls.verify(group_pk, msg, group_sig)
+
+
+def test_lock_verify_rejects_tampering():
+    n, t, v = 3, 2, 1
+    defn, keys = make_definition(n, t, v)
+
+    async def run():
+        fnet = frost.MemFrostTransport(n)
+        xnet = MemExchangeNet(n)
+        return await asyncio.gather(
+            *(
+                run_dkg(defn, i, keys[i], fnet.participant(i + 1), xnet.port(i))
+                for i in range(n)
+            )
+        )
+
+    results = asyncio.run(run())
+    lock = results[0].lock
+    pubs = [k1util.public_key_to_bytes(k.public_key()) for k in keys]
+
+    import dataclasses
+
+    bad = dataclasses.replace(
+        lock, node_signatures=tuple(reversed(lock.node_signatures))
+    )
+    with pytest.raises(ValueError):
+        bad.verify(pubs)

@@ -1,0 +1,87 @@
+"""FROST DKG: ceremony outputs form a working t-of-n threshold key."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.crypto import bls
+from charon_tpu.crypto.fields import R
+from charon_tpu.crypto.g1g2 import g1_to_bytes
+from charon_tpu.crypto.shamir import recover_secret
+from charon_tpu.dkg import frost
+
+CTX = b"cluster-def-hash"
+
+
+def run_ceremony(n=4, t=3, v=2):
+    async def run():
+        net = frost.MemFrostTransport(n)
+        tasks = [
+            frost.run_frost_parallel(
+                net.participant(i), i, n, t, v, CTX
+            )
+            for i in range(1, n + 1)
+        ]
+        return await asyncio.gather(*tasks)
+
+    return asyncio.run(run())
+
+
+def test_frost_outputs_consistent_threshold_keys():
+    n, t, v = 4, 3, 2
+    results = run_ceremony(n, t, v)  # results[node-1][validator]
+
+    for val in range(v):
+        # every node derived the same group pubkey and pubshares
+        pks = {g1_to_bytes(results[i][val].group_pubkey) for i in range(n)}
+        assert len(pks) == 1
+        pubshares = results[0][val].pubshares
+        for i in range(1, n):
+            assert results[i][val].pubshares == pubshares
+
+        # each node's secret share matches its pubshare
+        for i in range(n):
+            share = results[i][val].secret_share
+            assert bls.sk_to_pk(share) == pubshares[i + 1]
+
+        # any t shares recover a secret matching the group pubkey
+        shares = {i + 1: results[i][val].secret_share for i in range(t)}
+        group_secret = recover_secret(shares)
+        assert bls.sk_to_pk(group_secret) == results[0][val].group_pubkey
+
+        # and threshold signing works end to end
+        msg = b"frost validator %d" % val
+        partials = {
+            i + 1: bls.sign(results[i][val].secret_share, msg)
+            for i in range(1, 1 + t)
+        }
+        from charon_tpu.crypto.shamir import threshold_aggregate_g2
+
+        group_sig = threshold_aggregate_g2(partials)
+        assert bls.verify(results[0][val].group_pubkey, msg, group_sig)
+
+
+def test_frost_rejects_bad_share():
+    n, t, v = 3, 2, 1
+
+    async def run():
+        net = frost.MemFrostTransport(n)
+        parts = {
+            i: frost.FrostParticipant(i, n, t, v, CTX)
+            for i in range(1, n + 1)
+        }
+        r1 = {i: parts[i].round1() for i in parts}
+        all_bcasts = {i: r1[i][0] for i in parts}
+
+        # corrupt the share peer 2 sends to peer 1
+        shares_to_1 = {
+            i: r1[i][1][1] for i in parts
+        }
+        bad = frost.Round1Shares(
+            shares=tuple((s + 1) % R for s in shares_to_1[2].shares)
+        )
+        shares_to_1[2] = bad
+        with pytest.raises(ValueError, match="invalid share from peer 2"):
+            parts[1].round2(all_bcasts, shares_to_1)
+
+    asyncio.run(run())
