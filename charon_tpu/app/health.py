@@ -1,0 +1,87 @@
+"""Health checks over a rolling in-memory metric window.
+
+Mirrors ref: app/health — a 10-minute rolling store of samples from the
+node's own metrics, evaluated by declarative checks
+(health/checker.go, checks health/checks.go:41-151): beacon node syncing,
+insufficient connected peers, high error rates, pending duties.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass
+from typing import Callable
+
+WINDOW_SECS = 600.0  # ref: app/health 10-minute window
+
+
+class MetricStore:
+    def __init__(self, now=time.time) -> None:
+        self._now = now
+        self._series: dict[str, deque] = defaultdict(deque)
+
+    def sample(self, name: str, value: float) -> None:
+        q = self._series[name]
+        t = self._now()
+        q.append((t, value))
+        while q and q[0][0] < t - WINDOW_SECS:
+            q.popleft()
+
+    def latest(self, name: str, default: float = 0.0) -> float:
+        q = self._series.get(name)
+        return q[-1][1] if q else default
+
+    def increase(self, name: str) -> float:
+        """Increase of a counter over the window."""
+        q = self._series.get(name)
+        if not q or len(q) < 2:
+            return 0.0
+        return max(0.0, q[-1][1] - q[0][1])
+
+
+@dataclass
+class Check:
+    name: str
+    description: str
+    failing: Callable[[MetricStore], bool]
+
+
+def default_checks(quorum: int) -> list[Check]:
+    """ref: health/checks.go:41-151 (beacon sync, peer connectivity,
+    error spikes, duty failures)."""
+    return [
+        Check(
+            "beacon_node_syncing",
+            "beacon node is syncing",
+            lambda m: m.latest("app_beacon_syncing") > 0,
+        ),
+        Check(
+            "insufficient_peers",
+            "fewer than quorum-1 peers connected",
+            lambda m: m.latest("p2p_peers_connected") < quorum - 1,
+        ),
+        Check(
+            "high_error_rate",
+            "log error rate spiked in the window",
+            lambda m: m.increase("app_log_errors") > 10,
+        ),
+        Check(
+            "failed_duties",
+            "duties failed in the window",
+            lambda m: m.increase("core_tracker_failed_duties") > 0,
+        ),
+    ]
+
+
+class HealthChecker:
+    def __init__(self, store: MetricStore, checks: list[Check]) -> None:
+        self.store = store
+        self.checks = checks
+
+    def evaluate(self) -> dict[str, bool]:
+        """check name -> failing?"""
+        return {c.name: c.failing(self.store) for c in self.checks}
+
+    def healthy(self) -> bool:
+        return not any(self.evaluate().values())

@@ -1,0 +1,129 @@
+"""Prometheus metrics with mandatory cluster labels.
+
+Mirrors ref: app/promauto — a registry whose metrics all carry
+cluster-identifying labels (app/app.go:227-241), plus the monitoring
+HTTP endpoints (/metrics, /readyz, /livez — app/monitoringapi.go:47-122).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+
+@dataclass
+class ClusterMetrics:
+    """Registry with cluster_hash/cluster_name/peer labels applied to every
+    series (ref: promauto.NewRegistry cluster labels)."""
+
+    cluster_hash: str
+    cluster_name: str
+    peer: str
+
+    def __post_init__(self) -> None:
+        self.registry = CollectorRegistry()
+        labels = ["cluster_hash", "cluster_name", "peer"]
+        self._label_values = [self.cluster_hash, self.cluster_name, self.peer]
+
+        def counter(name, doc, extra=()):
+            c = Counter(name, doc, labels + list(extra), registry=self.registry)
+            return c
+
+        self.duty_total = counter(
+            "core_scheduler_duty_total", "Duties scheduled", ["duty"]
+        )
+        self.consensus_decided = counter(
+            "core_consensus_decided_total", "Consensus decisions", ["duty"]
+        )
+        self.parsig_received = counter(
+            "core_parsigex_received_total", "Partial signatures received", ["duty"]
+        )
+        self.sigagg_total = counter(
+            "core_sigagg_aggregated_total", "Aggregated signatures", ["duty"]
+        )
+        self.bcast_total = counter(
+            "core_bcast_broadcast_total", "Broadcast duties", ["duty"]
+        )
+        self.tracker_failed = counter(
+            "core_tracker_failed_duties_total", "Failed duties", ["duty", "step"]
+        )
+        self.peer_ping = Gauge(
+            "p2p_ping_success",
+            "Peer ping success",
+            labels + ["peer_index"],
+            registry=self.registry,
+        )
+        self.bcast_delay = Histogram(
+            "core_bcast_delay_seconds",
+            "Broadcast delay into the slot",
+            labels,
+            registry=self.registry,
+        )
+        self.batch_size = Histogram(
+            "tpu_batch_size",
+            "Device batch sizes for crypto kernels",
+            labels + ["kernel"],
+            registry=self.registry,
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
+        )
+
+    def labels(self, metric, *extra):
+        return metric.labels(*self._label_values, *extra)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+async def serve_monitoring(
+    host: str,
+    port: int,
+    metrics: ClusterMetrics,
+    health_checker=None,
+    ready_fn=None,
+) -> asyncio.AbstractServer:
+    """Minimal HTTP endpoint: /metrics, /livez, /readyz
+    (ref: app/monitoringapi.go:47)."""
+
+    async def handle(reader, writer):
+        try:
+            request = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b""):
+                pass
+            path = request.split()[1].decode() if request.split() else "/"
+            if path.startswith("/metrics"):
+                body = metrics.render()
+                ctype = b"text/plain; version=0.0.4"
+                status = b"200 OK"
+            elif path.startswith("/livez"):
+                body = b"ok"
+                ctype = b"text/plain"
+                status = b"200 OK"
+            elif path.startswith("/readyz"):
+                ready = ready_fn() if ready_fn else True
+                healthy = health_checker.healthy() if health_checker else True
+                ok = ready and healthy
+                body = b"ok" if ok else b"not ready"
+                ctype = b"text/plain"
+                status = b"200 OK" if ok else b"503 Service Unavailable"
+            else:
+                body = b"not found"
+                ctype = b"text/plain"
+                status = b"404 Not Found"
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\nContent-Type: " + ctype
+                + b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\nConnection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host, port)

@@ -1,0 +1,66 @@
+"""Deadline-bounded async retry of workflow steps.
+
+Mirrors ref: app/retry/retry.go:28-120 — each duty step is retried with a
+constant 1s backoff until the duty's deadline, with error classification
+(network-ish errors retried, programming errors surfaced immediately).
+Wired into the workflow as a wire() option (ref: core.WithAsyncRetry,
+app/app.go:571).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+BACKOFF_SECS = 1.0  # ref: retry/retry.go constant backoff
+
+
+RETRYABLE = (ConnectionError, TimeoutError, asyncio.TimeoutError, OSError)
+
+
+class Retryer:
+    """deadline_of: maps a duty to its absolute deadline (SlotClock)."""
+
+    def __init__(self, deadline_of, now=time.time, backoff: float = BACKOFF_SECS) -> None:
+        self.deadline_of = deadline_of
+        self.now = now
+        self.backoff = backoff
+        self._tasks: set[asyncio.Task] = set()
+
+    async def retry(self, name: str, duty, fn, *args) -> None:
+        deadline = self.deadline_of(duty)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                await fn(duty, *args)
+                return
+            except RETRYABLE:
+                if self.now() + self.backoff >= deadline:
+                    return  # deadline exceeded; tracker reports the miss
+                await asyncio.sleep(self.backoff)
+            except Exception:
+                raise  # non-retryable: surface immediately
+
+    def spawn(self, name: str, duty, fn, *args) -> None:
+        """DoAsync (ref: retry.go:93): fire-and-forget with retries."""
+        task = asyncio.create_task(self.retry(name, duty, fn, *args))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+
+def with_async_retry(retryer: Retryer, edges: set[str] | None = None):
+    """wire() option: wrap edges in deadline-bounded async retries."""
+    edges = edges or {"fetcher.fetch"}
+
+    def option(name: str, fn):
+        if name not in edges:
+            return fn
+
+        async def wrapped(duty, *args, **kwargs):
+            retryer.spawn(name, duty, fn, *args)
+
+        return wrapped
+
+    return option

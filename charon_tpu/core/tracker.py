@@ -1,0 +1,190 @@
+"""Tracker: per-duty failure detection and peer participation.
+
+Mirrors ref: core/tracker — every workflow component emits an event per
+duty step (step enum tracker.go:20-34); when the Deadliner expires a duty
+the tracker determines the first failing step and a reason
+(tracker.go:103, reasons reason.go), plus per-peer participation from the
+partial signatures observed (tracker.go:106) and unexpected-peer checks.
+
+Wiring: `tracking(tracker)` is a wire() option that wraps every
+subscription edge (ref: core/tracking.go wraps via core.WithTracking).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from charon_tpu.core.types import Duty, PubKey
+
+
+class Step(enum.IntEnum):
+    """Workflow steps in pipeline order (ref: core/tracker/tracker.go:20)."""
+
+    SCHEDULER = 0
+    FETCHER = 1
+    CONSENSUS = 2
+    DUTY_DB = 3
+    VALIDATOR_API = 4
+    PARSIG_DB_INTERNAL = 5
+    PARSIG_EX = 6
+    PARSIG_DB_THRESHOLD = 7
+    SIG_AGG = 8
+    AGG_SIG_DB = 9
+    BCAST = 10
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+
+# Map wire() edge names to the steps their completion proves. An edge
+# firing proves the *previous* step delivered (e.g. fetcher.fetch being
+# invoked proves the scheduler emitted the duty).
+_EDGE_STEPS: dict[str, tuple[Step, ...]] = {
+    "fetcher.fetch": (Step.SCHEDULER, Step.FETCHER),
+    "consensus.propose": (Step.CONSENSUS,),
+    "dutydb.store": (Step.DUTY_DB,),
+    "parsigdb.store_internal": (Step.VALIDATOR_API, Step.PARSIG_DB_INTERNAL),
+    "parsigex.broadcast": (Step.PARSIG_EX,),
+    "parsigdb.store_external": (Step.PARSIG_EX,),
+    "sigagg.aggregate": (Step.PARSIG_DB_THRESHOLD, Step.SIG_AGG),
+    "aggsigdb.store": (Step.AGG_SIG_DB,),
+    "broadcaster.broadcast": (Step.BCAST,),
+}
+
+
+class Reason(str, enum.Enum):
+    """Failure reasons (ref: core/tracker/reason.go)."""
+
+    NOT_SCHEDULED = "duty was never scheduled"
+    FETCH_FAILED = "failed to fetch duty data from the beacon node"
+    NO_CONSENSUS = "consensus was not reached"
+    NO_LOCAL_PARTIAL = "validator client did not submit a partial signature"
+    INSUFFICIENT_PARTIALS = "insufficient partial signatures from peers"
+    AGGREGATION_FAILED = "threshold aggregation or verification failed"
+    BROADCAST_FAILED = "failed to broadcast to the beacon node"
+    UNKNOWN = "unexpected failure"
+
+
+_FAIL_REASONS = {
+    Step.SCHEDULER: Reason.NOT_SCHEDULED,
+    Step.FETCHER: Reason.FETCH_FAILED,
+    Step.CONSENSUS: Reason.NO_CONSENSUS,
+    Step.DUTY_DB: Reason.NO_LOCAL_PARTIAL,
+    Step.VALIDATOR_API: Reason.NO_LOCAL_PARTIAL,
+    Step.PARSIG_DB_INTERNAL: Reason.INSUFFICIENT_PARTIALS,
+    Step.PARSIG_EX: Reason.INSUFFICIENT_PARTIALS,
+    Step.PARSIG_DB_THRESHOLD: Reason.AGGREGATION_FAILED,
+    Step.SIG_AGG: Reason.AGGREGATION_FAILED,
+    Step.AGG_SIG_DB: Reason.AGGREGATION_FAILED,
+    Step.BCAST: Reason.BROADCAST_FAILED,
+}
+
+
+@dataclass
+class DutyReport:
+    duty: Duty
+    success: bool
+    failed_step: Step | None
+    reason: Reason | None
+    participation: dict[int, bool]  # share_idx -> partial sig seen
+    errors: list[str] = field(default_factory=list)
+
+
+ReportSub = Callable[[DutyReport], Awaitable[None] | None]
+
+
+class Tracker:
+    """threshold/peers: for participation accounting."""
+
+    def __init__(self, peer_share_indices: list[int]) -> None:
+        self.peer_share_indices = list(peer_share_indices)
+        self._steps: dict[Duty, set[Step]] = defaultdict(set)
+        self._participation: dict[Duty, set[int]] = defaultdict(set)
+        self._errors: dict[Duty, list[str]] = defaultdict(list)
+        self._subs: list[ReportSub] = []
+        self.failed_total: dict[tuple, int] = defaultdict(int)
+        self.success_total: dict[Duty, int] = {}
+        self.participation_total: dict[int, int] = defaultdict(int)
+
+    def subscribe(self, sub: ReportSub) -> None:
+        self._subs.append(sub)
+
+    # -- event intake -----------------------------------------------------
+
+    def step_event(self, duty: Duty, step: Step) -> None:
+        self._steps[duty].add(step)
+
+    def step_failed(self, duty: Duty, step: Step, err: Exception) -> None:
+        self._errors[duty].append(f"{step}: {err}")
+
+    def partial_observed(self, duty: Duty, share_idx: int) -> None:
+        self._participation[duty].add(share_idx)
+
+    # -- analysis at duty expiry (ref: tracker.go:103) --------------------
+
+    async def duty_expired(self, duty: Duty) -> DutyReport:
+        steps = self._steps.pop(duty, set())
+        participation = self._participation.pop(duty, set())
+        errors = self._errors.pop(duty, [])
+        success = Step.BCAST in steps
+
+        failed_step = None
+        reason = None
+        if not success:
+            # first pipeline step that never happened
+            for step in Step:
+                if step not in steps:
+                    failed_step = step
+                    reason = _FAIL_REASONS.get(step, Reason.UNKNOWN)
+                    break
+            self.failed_total[(duty.type, failed_step)] += 1
+
+        part_map = {
+            idx: idx in participation for idx in self.peer_share_indices
+        }
+        for idx in participation:
+            self.participation_total[idx] += 1
+
+        report = DutyReport(
+            duty=duty,
+            success=success,
+            failed_step=failed_step,
+            reason=reason,
+            participation=part_map,
+            errors=errors,
+        )
+        for sub in self._subs:
+            res = sub(report)
+            if hasattr(res, "__await__"):
+                await res
+        return report
+
+
+def tracking(tracker: Tracker):
+    """wire() option emitting tracker events around every edge
+    (ref: core/tracking.go + core.WithTracking)."""
+
+    def option(name: str, fn):
+        steps = _EDGE_STEPS.get(name)
+        if steps is None:
+            return fn
+
+        async def wrapped(duty, *args, **kwargs):
+            try:
+                result = await fn(duty, *args, **kwargs)
+            except Exception as e:
+                tracker.step_failed(duty, steps[-1], e)
+                raise
+            for step in steps:
+                tracker.step_event(duty, step)
+            if name in ("parsigdb.store_external", "parsigdb.store_internal") and args:
+                for psig in args[0].values():
+                    tracker.partial_observed(duty, psig.share_idx)
+            return result
+
+        return wrapped
+
+    return option

@@ -1,0 +1,176 @@
+"""App infrastructure: lifecycle ordering, retry, featureset, health,
+metrics endpoint, tracker failure analysis."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.app import featureset
+from charon_tpu.app.health import Check, HealthChecker, MetricStore
+from charon_tpu.app.lifecycle import LifecycleManager, Order
+from charon_tpu.app.metrics import ClusterMetrics, serve_monitoring
+from charon_tpu.app.retry import Retryer
+from charon_tpu.core.tracker import Reason, Step, Tracker, tracking
+from charon_tpu.core.types import Duty, DutyType
+
+
+def test_lifecycle_order_and_shutdown():
+    async def run():
+        events = []
+        life = LifecycleManager()
+
+        async def bg(name):
+            events.append(f"start:{name}")
+            try:
+                await asyncio.sleep(100)
+            except asyncio.CancelledError:
+                raise
+
+        life.register_start(Order.SCHEDULER, "sched", lambda: bg("sched"))
+        life.register_start(Order.P2P, "p2p", lambda: bg("p2p"))
+
+        async def stop_hook():
+            events.append("stop:p2p")
+
+        life.register_stop(Order.P2P, "p2p", stop_hook)
+
+        stop = asyncio.Event()
+        task = asyncio.create_task(life.run(stop))
+        await asyncio.sleep(0.05)
+        assert events == ["start:p2p", "start:sched"]  # ordered
+        stop.set()
+        await asyncio.wait_for(task, 10)
+        assert events[-1] == "stop:p2p"
+
+    asyncio.run(run())
+
+
+def test_retryer_retries_until_deadline():
+    async def run():
+        now = [0.0]
+        attempts = []
+
+        async def flaky(duty):
+            attempts.append(now[0])
+            now[0] += 1.1  # each attempt costs 1.1s virtual time
+            raise ConnectionError("bn down")
+
+        r = Retryer(
+            deadline_of=lambda duty: 3.0,
+            now=lambda: now[0],
+            backoff=0.0,  # no real sleeping in tests
+        )
+        await r.retry("fetch", Duty(1, DutyType.ATTESTER), flaky)
+        assert 2 <= len(attempts) <= 4  # bounded by the deadline
+
+        async def boom(duty):
+            raise ValueError("programming error")
+
+        with pytest.raises(ValueError):
+            await r.retry("fetch", Duty(1, DutyType.ATTESTER), boom)
+
+    asyncio.run(run())
+
+
+def test_featureset_statuses():
+    featureset.init(featureset.Status.STABLE)
+    assert featureset.enabled(featureset.Feature.QBFT_CONSENSUS)
+    assert not featureset.enabled(featureset.Feature.AGG_SIG_DB_V2)
+    featureset.init(
+        featureset.Status.STABLE, enable=[featureset.Feature.AGG_SIG_DB_V2]
+    )
+    assert featureset.enabled(featureset.Feature.AGG_SIG_DB_V2)
+    featureset.init(
+        featureset.Status.STABLE, disable=[featureset.Feature.QBFT_CONSENSUS]
+    )
+    assert not featureset.enabled(featureset.Feature.QBFT_CONSENSUS)
+    featureset.init(featureset.Status.STABLE)
+
+
+def test_health_checks():
+    now = [0.0]
+    store = MetricStore(now=lambda: now[0])
+    checker = HealthChecker(
+        store,
+        [
+            Check("errors", "err spike", lambda m: m.increase("errs") > 10),
+            Check("peers", "low peers", lambda m: m.latest("peers", 0) < 2),
+        ],
+    )
+    store.sample("errs", 0)
+    store.sample("peers", 3)
+    assert checker.healthy()
+    now[0] = 60
+    store.sample("errs", 20)  # +20 errors in window
+    assert checker.evaluate() == {"errors": True, "peers": False}
+    assert not checker.healthy()
+
+
+def test_metrics_endpoint():
+    async def run():
+        m = ClusterMetrics("0xhash", "c", "node0")
+        m.labels(m.bcast_total, "attester").inc()
+        server = await serve_monitoring("127.0.0.1", 0, m)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        data = await reader.read(-1)
+        assert b"core_bcast_broadcast_total" in data
+        assert b'peer="node0"' in data
+        writer.close()
+        server.close()
+
+    asyncio.run(run())
+
+
+def test_tracker_failure_analysis():
+    async def run():
+        duty = Duty(3, DutyType.ATTESTER)
+        tr = Tracker(peer_share_indices=[1, 2, 3, 4])
+        reports = []
+        tr.subscribe(reports.append)
+
+        # simulate a duty that got through consensus but no partials
+        for s in (Step.SCHEDULER, Step.FETCHER, Step.CONSENSUS, Step.DUTY_DB):
+            tr.step_event(duty, s)
+        tr.partial_observed(duty, 1)
+        report = await tr.duty_expired(duty)
+        assert not report.success
+        assert report.failed_step == Step.VALIDATOR_API
+        assert report.reason == Reason.NO_LOCAL_PARTIAL
+        assert report.participation == {1: True, 2: False, 3: False, 4: False}
+        assert reports == [report]
+
+        # successful duty
+        duty2 = Duty(4, DutyType.ATTESTER)
+        for s in Step:
+            tr.step_event(duty2, s)
+        report2 = await tr.duty_expired(duty2)
+        assert report2.success and report2.failed_step is None
+
+    asyncio.run(run())
+
+
+def test_tracking_wire_option():
+    async def run():
+        duty = Duty(5, DutyType.ATTESTER)
+        tr = Tracker(peer_share_indices=[1, 2])
+
+        async def fetch(duty, defs):
+            return None
+
+        wrapped = tracking(tr)("fetcher.fetch", fetch)
+        await wrapped(duty, {})
+        assert Step.SCHEDULER in tr._steps[duty]
+        assert Step.FETCHER in tr._steps[duty]
+
+        async def broken(duty, defs):
+            raise RuntimeError("bn error")
+
+        wrapped_bad = tracking(tr)("consensus.propose", broken)
+        with pytest.raises(RuntimeError):
+            await wrapped_bad(duty, {})
+        assert tr._errors[duty]
+
+    asyncio.run(run())
