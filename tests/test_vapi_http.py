@@ -1,0 +1,154 @@
+"""ValidatorAPI HTTP router + eth2wrap multi-client failover."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.app.eth2wrap import AllClientsFailedError, MultiClient
+from charon_tpu.core.dutydb import DutyDB
+from charon_tpu.core.eth2data import SignedData
+from charon_tpu.core.scheduler import DutyDefinition
+from charon_tpu.core.types import Duty, DutyType, pubkey_from_bytes
+from charon_tpu.core.validatorapi import ValidatorAPI
+from charon_tpu.core.vapi_http import VapiRouter, _bits_from_hex, _bits_to_hex
+from charon_tpu.tbls.python_impl import PythonImpl
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.simnet import SIMNET_FORK
+
+
+@pytest.fixture(autouse=True)
+def python_tbls():
+    tbls.set_implementation(PythonImpl())
+    yield
+
+
+def test_bitlist_hex_roundtrip():
+    bits = (True, False, False, True, False)
+    assert _bits_from_hex(_bits_to_hex(bits)) == bits
+    assert _bits_from_hex("0x01") == ()  # empty list, just delimiter
+
+
+def test_vapi_http_attestation_flow():
+    async def run():
+        impl = tbls.get_implementation()
+        secret = impl.generate_secret_key()
+        shares = impl.threshold_split(secret, 3, 2)
+        group_pk = pubkey_from_bytes(impl.secret_to_public_key(secret))
+        pubshare = impl.secret_to_public_key(shares[1])
+
+        dutydb = DutyDB()
+        vapi = ValidatorAPI(
+            share_idx=1,
+            pubshares={group_pk: pubshare},
+            fork=SIMNET_FORK,
+            slots_per_epoch=8,
+        )
+        vapi.register_await_attestation(dutydb.await_attestation)
+        vapi.register_pubkey_by_attestation(dutydb.pubkey_by_attestation)
+        vapi.register_get_duty_definition(
+            lambda duty: {
+                group_pk: DutyDefinition(
+                    pubkey=group_pk, validator_index=0, committee_index=1,
+                    committee_length=1,
+                )
+            }
+        )
+        submitted = []
+
+        async def sub(duty, sset):
+            submitted.append((duty, sset))
+
+        vapi.subscribe(sub)
+
+        router = VapiRouter(vapi)
+        port = await router.start()
+        try:
+            # store consensus data, then the VC pulls it over HTTP
+            beacon = BeaconMock(validators={group_pk: 0})
+            data = await beacon.attestation_data(5, 1)
+            from charon_tpu.core.eth2data import AttestationDuty
+
+            await dutydb.store(
+                Duty(5, DutyType.ATTESTER),
+                {
+                    group_pk: AttestationDuty(
+                        data=data,
+                        committee_length=1,
+                        committee_index=1,
+                        validator_committee_index=0,
+                    )
+                },
+            )
+
+            async with aiohttp.ClientSession() as sess:
+                url = f"http://127.0.0.1:{port}"
+                async with sess.get(
+                    f"{url}/eth/v1/validator/attestation_data",
+                    params={"slot": "5", "committee_index": "1"},
+                ) as resp:
+                    assert resp.status == 200
+                    j = await resp.json()
+                    assert j["data"]["slot"] == "5"
+
+                # sign and submit through the HTTP endpoint
+                from charon_tpu.core.eth2data import Attestation
+
+                att = Attestation(aggregation_bits=(True,), data=data)
+                root = SignedData("attestation", att).signing_root(
+                    SIMNET_FORK, 0
+                )
+                sig = impl.sign(shares[1], root)
+                payload = [
+                    {
+                        "aggregation_bits": _bits_to_hex((True,)),
+                        "data": j["data"],
+                        "signature": "0x" + sig.hex(),
+                    }
+                ]
+                async with sess.post(
+                    f"{url}/eth/v1/beacon/pool/attestations", json=payload
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+
+                # bad signature rejected
+                payload[0]["signature"] = "0x" + (b"\x01" * 96).hex()
+                async with sess.post(
+                    f"{url}/eth/v1/beacon/pool/attestations", json=payload
+                ) as resp:
+                    assert resp.status == 400
+
+                async with sess.get(f"{url}/eth/v1/node/version") as resp:
+                    assert "charon-tpu" in (await resp.json())["data"]["version"]
+
+            assert len(submitted) == 1
+            duty, sset = submitted[0]
+            assert duty == Duty(5, DutyType.ATTESTER)
+            assert sset[group_pk].share_idx == 1
+        finally:
+            await router.stop()
+
+    asyncio.run(run())
+
+
+def test_multi_client_failover():
+    async def run():
+        class Failing:
+            async def attestation_data(self, slot, ci):
+                raise ConnectionError("down")
+
+        class Working:
+            async def attestation_data(self, slot, ci):
+                return ("data", slot, ci)
+
+        multi = MultiClient([Failing(), Working()])
+        assert await multi.attestation_data(1, 2) == ("data", 1, 2)
+        # the failing client accumulates errors and loses priority
+        assert multi.errors[0] > 0
+
+        multi_bad = MultiClient([Failing()])
+        with pytest.raises(AllClientsFailedError):
+            await multi_bad.attestation_data(1, 2)
+
+    asyncio.run(run())
