@@ -1,0 +1,146 @@
+"""Priority protocol: cluster-wide preference negotiation.
+
+Mirrors ref: core/priority — each node exchanges signed priority messages
+listing its ordered preferences per topic (prioritiser.go:326), computes
+the cluster-wide ordering (calculate.go: priorities supported by at least
+quorum peers, ordered by aggregate position score), then agrees on the
+result via a consensus instance. Infosync (ref: core/infosync) triggers it
+in the last slot of each epoch and feeds the result to the consensus
+controller for protocol switching (ref: app/app.go:650-668).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Sequence
+
+from charon_tpu.core.types import Duty, DutyType
+
+
+@dataclass(frozen=True)
+class PriorityMsg:
+    peer_idx: int
+    slot: int
+    topics: tuple[tuple[str, tuple[str, ...]], ...]  # (topic, ordered prefs)
+
+
+@dataclass(frozen=True)
+class TopicResult:
+    topic: str
+    priorities: tuple[str, ...]  # cluster-agreed order
+
+
+def calculate(msgs: Sequence[PriorityMsg], quorum: int) -> list[TopicResult]:
+    """Cluster-wide ordering (ref: core/priority/calculate.go:205):
+    a priority is included iff at least `quorum` peers list it; included
+    priorities are ordered by total score (higher list positions score
+    more), ties broken lexically for determinism."""
+    by_topic: dict[str, list[tuple[int, tuple[str, ...]]]] = defaultdict(list)
+    for m in msgs:
+        for topic, prefs in m.topics:
+            by_topic[topic].append((m.peer_idx, prefs))
+
+    out = []
+    for topic in sorted(by_topic):
+        counts: dict[str, int] = defaultdict(int)
+        scores: dict[str, int] = defaultdict(int)
+        for _, prefs in by_topic[topic]:
+            for pos, p in enumerate(prefs):
+                counts[p] += 1
+                scores[p] += len(prefs) - pos
+        included = [p for p, c in counts.items() if c >= quorum]
+        included.sort(key=lambda p: (-scores[p], p))
+        out.append(TopicResult(topic=topic, priorities=tuple(included)))
+    return out
+
+
+class Prioritiser:
+    """exchange: async callable broadcasting our msg and returning all
+    peers' msgs (the p2p or in-memory fabric); consensus: object with
+    propose(duty, value_set) + subscribe(cb) — the cluster's consensus
+    component, reused for agreement on the result."""
+
+    def __init__(
+        self,
+        node_idx: int,
+        quorum: int,
+        exchange,
+        consensus,
+        topics_fn: Callable[[], dict[str, list[str]]],
+        timeout: float = 6.0,  # ref: app/app.go:610 priority exchange timeout
+    ) -> None:
+        self.node_idx = node_idx
+        self.quorum = quorum
+        self.exchange = exchange
+        self.consensus = consensus
+        self.topics_fn = topics_fn
+        self.timeout = timeout
+        self._subs: list = []
+        consensus.subscribe(self._on_decided)
+
+    def subscribe(self, sub) -> None:
+        """sub(slot, list[TopicResult])"""
+        self._subs.append(sub)
+
+    async def prioritise(self, slot: int) -> None:
+        """One negotiation round (ref: prioritiser.go:326 Prioritise)."""
+        topics = tuple(
+            (t, tuple(prefs)) for t, prefs in sorted(self.topics_fn().items())
+        )
+        my_msg = PriorityMsg(self.node_idx, slot, topics)
+        msgs = await asyncio.wait_for(
+            self.exchange(slot, my_msg), self.timeout
+        )
+        result = calculate(list(msgs.values()), self.quorum)
+        duty = Duty(slot, DutyType.INFO_SYNC)
+        await self.consensus.propose(
+            duty, {"priority": tuple(result)}
+        )
+
+    async def _on_decided(self, duty: Duty, value_set) -> None:
+        if duty.type != DutyType.INFO_SYNC:
+            return
+        result = value_set.get("priority")
+        if result is None:
+            return
+        for sub in self._subs:
+            await sub(duty.slot, list(result))
+
+
+class InfoSync:
+    """Triggers prioritisation in the last slot of each epoch
+    (ref: core/infosync/infosync.go:145; wiring app/app.go:638-644)."""
+
+    TOPIC_PROTOCOL = "consensus_protocol"
+    TOPIC_VERSION = "node_version"
+
+    def __init__(self, prioritiser: Prioritiser) -> None:
+        self.prioritiser = prioritiser
+        self._last_epoch = -1
+
+    async def on_slot(self, slot) -> None:
+        if not slot.is_last_in_epoch():
+            return
+        if slot.epoch == self._last_epoch:
+            return
+        self._last_epoch = slot.epoch
+        try:
+            await self.prioritiser.prioritise(slot.slot)
+        except asyncio.TimeoutError:
+            pass  # negotiation is best-effort per epoch
+
+
+def protocol_switcher(controller):
+    """Priority subscriber that switches the consensus protocol to the
+    cluster's top choice (ref: app/app.go:650-668)."""
+
+    async def on_result(slot: int, results: list[TopicResult]) -> None:
+        for r in results:
+            if r.topic == InfoSync.TOPIC_PROTOCOL and r.priorities:
+                for proto in r.priorities:
+                    if controller.set_current_for_protocol(proto):
+                        break
+
+    return on_result

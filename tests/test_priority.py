@@ -1,0 +1,101 @@
+"""Priority protocol: calculation rules and infosync-driven switching."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core.consensus import ConsensusController, EchoConsensus
+from charon_tpu.core.priority import (
+    InfoSync,
+    Prioritiser,
+    PriorityMsg,
+    TopicResult,
+    calculate,
+    protocol_switcher,
+)
+from charon_tpu.core.scheduler import Slot
+
+
+def msg(idx, **topics):
+    return PriorityMsg(
+        peer_idx=idx,
+        slot=10,
+        topics=tuple((t, tuple(v)) for t, v in sorted(topics.items())),
+    )
+
+
+def test_calculate_quorum_and_ordering():
+    msgs = [
+        msg(0, proto=["qbft/2.0", "echo/1.0"]),
+        msg(1, proto=["qbft/2.0", "echo/1.0"]),
+        msg(2, proto=["echo/1.0", "qbft/2.0"]),
+        msg(3, proto=["other/9.9"]),
+    ]
+    [result] = calculate(msgs, quorum=3)
+    # other/9.9 only has 1 supporter -> excluded; qbft scores higher
+    assert result.topic == "proto"
+    assert result.priorities == ("qbft/2.0", "echo/1.0")
+
+
+def test_calculate_empty_on_no_quorum():
+    msgs = [msg(0, proto=["a"]), msg(1, proto=["b"])]
+    [result] = calculate(msgs, quorum=3)
+    assert result.priorities == ()
+
+
+def test_prioritiser_and_switcher_end_to_end():
+    async def run():
+        n = 3
+
+        # Echo-consensus controller doubles as the agreement mechanism.
+        class SwitchableEcho(EchoConsensus):
+            protocol_id = "echo/1.0.0"
+
+        class OtherEcho(EchoConsensus):
+            protocol_id = "qbft/2.0.0"
+
+        default = SwitchableEcho()
+        other = OtherEcho()
+        controller = ConsensusController(default)
+        controller.register(other)
+
+        # in-memory exchange fabric
+        store: dict[int, PriorityMsg] = {}
+
+        async def exchange(slot, my_msg):
+            store[my_msg.peer_idx] = my_msg
+            # single-process: everyone already "sent" by test construction
+            return dict(store)
+
+        results = []
+        prior = Prioritiser(
+            node_idx=0,
+            quorum=2,
+            exchange=exchange,
+            consensus=controller,
+            topics_fn=lambda: {
+                InfoSync.TOPIC_PROTOCOL: ["qbft/2.0.0", "echo/1.0.0"]
+            },
+        )
+        prior.subscribe(lambda slot, res: results.append(res) or _noop())
+        prior.subscribe(protocol_switcher(controller))
+
+        # seed peers' messages (as if already exchanged)
+        store[1] = msg(
+            1, **{InfoSync.TOPIC_PROTOCOL: ["qbft/2.0.0", "echo/1.0.0"]}
+        )
+        store[2] = msg(2, **{InfoSync.TOPIC_PROTOCOL: ["echo/1.0.0"]})
+
+        info = InfoSync(prior)
+        slot = Slot(slot=7, time=0, slot_duration=1, slots_per_epoch=8)
+        assert slot.is_last_in_epoch()
+        await info.on_slot(slot)
+
+        assert results, "no priority result delivered"
+        assert results[0][0].priorities[0] == "qbft/2.0.0"
+        assert controller.current_consensus() is other
+
+    async def _noop():
+        return None
+
+    asyncio.run(run())
