@@ -1,0 +1,293 @@
+"""Application wiring: config -> running node.
+
+Mirrors ref: app/app.go:131 Run — load the cluster lock, derive key maps,
+start p2p, monitoring, the core workflow (wire()), and the lifecycle
+manager. Every component is the production one; test configs swap the
+beacon client for a mock and transports for in-memory fakes
+(ref: app/app.go TestConfig pattern).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from charon_tpu import tbls
+from charon_tpu.app import k1util, log
+from charon_tpu.app.eth2wrap import MultiClient, ValidatorCache
+from charon_tpu.app.lifecycle import LifecycleManager, Order
+from charon_tpu.app.metrics import ClusterMetrics, serve_monitoring
+from charon_tpu.cluster.lock import ClusterLock
+from charon_tpu.core.aggsigdb import AggSigDB
+from charon_tpu.core.bcast import Broadcaster
+from charon_tpu.core.consensus import ConsensusController
+from charon_tpu.core.consensus_qbft import QBFTConsensus
+from charon_tpu.core.deadline import Deadliner, SlotClock
+from charon_tpu.core.dutydb import DutyDB
+from charon_tpu.core.fetcher import Fetcher
+from charon_tpu.core.parsigdb import ParSigDB
+from charon_tpu.core.parsigex import Eth2Verifier, ParSigEx
+from charon_tpu.core.scheduler import Scheduler
+from charon_tpu.core.sigagg import SigAgg
+from charon_tpu.core.tracker import Tracker, tracking
+from charon_tpu.core.types import PubKey, pubkey_from_bytes
+from charon_tpu.core.validatorapi import ValidatorAPI
+from charon_tpu.core.vapi_http import VapiRouter
+from charon_tpu.core.wire import wire
+from charon_tpu.eth2util import keystore
+from charon_tpu.eth2util.signing import ForkInfo
+from charon_tpu.p2p.adapters import TcpParSigTransport, TcpQbftNet
+from charon_tpu.p2p.transport import P2PNode, PeerSpec
+
+
+@dataclass
+class Config:
+    """ref: app/app.go:70-99 Config."""
+
+    data_dir: str
+    node_index: int  # 0-based operator index
+    p2p_host: str = "127.0.0.1"
+    p2p_port: int = 0
+    validator_api_port: int = 0
+    monitoring_port: int = 0
+    peer_addrs: list[tuple[str, int]] = field(default_factory=list)
+    beacon_nodes: list = field(default_factory=list)  # client objects
+    simnet: bool = False
+    slot_duration: float = 12.0
+    slots_per_epoch: int = 32
+    genesis_time: float | None = None
+    use_tpu_tbls: bool = True
+
+
+@dataclass
+class Node:
+    """A fully wired node (returned by build_node for tests/CLI)."""
+
+    config: Config
+    lock: ClusterLock
+    life: LifecycleManager
+    scheduler: Scheduler
+    vapi: ValidatorAPI
+    vapi_router: VapiRouter
+    p2p: P2PNode | None
+    bcast: Broadcaster
+    tracker: Tracker
+    metrics: ClusterMetrics
+    beacon: object
+
+
+async def build_node(config: Config) -> Node:
+    data_dir = Path(config.data_dir)
+    lock = ClusterLock.load(str(data_dir / "cluster-lock.json"))
+    n = len(lock.definition.operators)
+    t = lock.definition.threshold
+    share_idx = config.node_index + 1
+
+    if config.use_tpu_tbls:
+        from charon_tpu.tbls.tpu_impl import TPUImpl
+
+        tbls.set_implementation(TPUImpl())
+
+    # -- key material -----------------------------------------------------
+    share_secrets = keystore.load_keys(data_dir / "validator_keys")
+    group_pubkeys = [
+        pubkey_from_bytes(bytes.fromhex(v.distributed_public_key[2:]))
+        for v in lock.validators
+    ]
+    share_keys = dict(zip(group_pubkeys, share_secrets))
+    pubshares_by_idx: dict[int, dict[PubKey, bytes]] = {
+        j: {} for j in range(1, n + 1)
+    }
+    for v, gpk in zip(lock.validators, group_pubkeys):
+        for j in range(1, n + 1):
+            pubshares_by_idx[j][gpk] = bytes.fromhex(v.public_shares[j - 1][2:])
+    validators = {gpk: i for i, gpk in enumerate(group_pubkeys)}
+
+    k1_key = k1util.private_key_from_bytes(
+        (data_dir / "charon-enr-private-key").read_bytes()
+    )
+
+    fork = ForkInfo(
+        genesis_validators_root=hashlib.sha256(
+            b"gvr" + lock.lock_hash()
+        ).digest(),
+        fork_version=bytes.fromhex(lock.definition.fork_version[2:]),
+        genesis_fork_version=bytes.fromhex(lock.definition.fork_version[2:]),
+    )
+
+    # -- beacon client ----------------------------------------------------
+    import time as _time
+
+    if config.simnet or not config.beacon_nodes:
+        from charon_tpu.testutil.beaconmock import BeaconMock
+
+        beacon = BeaconMock(
+            validators=validators,
+            genesis_time=(
+                config.genesis_time
+                if config.genesis_time is not None
+                else _time.time()
+            ),
+            slot_duration=config.slot_duration,
+            slots_per_epoch=config.slots_per_epoch,
+        )
+        clock = beacon.clock()
+    else:
+        beacon = ValidatorCache(MultiClient(config.beacon_nodes))
+        clock = SlotClock(config.genesis_time or 0.0, config.slot_duration)
+
+    # -- metrics / lifecycle ----------------------------------------------
+    metrics = ClusterMetrics(
+        cluster_hash="0x" + lock.lock_hash().hex()[:16],
+        cluster_name=lock.definition.name,
+        peer=f"node{config.node_index}",
+    )
+    life = LifecycleManager()
+
+    # -- p2p --------------------------------------------------------------
+    p2p_node = None
+    qbft_net = None
+    parsig_transport = None
+    if config.peer_addrs:
+        specs = []
+        for i, (host, port) in enumerate(config.peer_addrs):
+            # operator ENR field carries the k1 pubkey hex in this format
+            pub = bytes.fromhex(lock.definition.operators[i].enr.split(":")[-1])
+            specs.append(PeerSpec(index=i, pubkey=pub, host=host, port=port))
+        p2p_node = P2PNode(
+            config.node_index, k1_key, specs, lock.lock_hash()
+        )
+        await p2p_node.start()
+        qbft_net = TcpQbftNet(p2p_node)
+        parsig_transport = TcpParSigTransport(p2p_node)
+        life.register_stop(Order.P2P, "p2p", p2p_node.stop)
+    else:
+        # single-node / in-memory configurations (tests wire their own)
+        from charon_tpu.core.consensus_qbft import MemMsgNet
+        from charon_tpu.core.parsigex import MemTransport
+
+        qbft_net = MemMsgNet()
+        parsig_transport = MemTransport()
+
+    # -- core workflow ----------------------------------------------------
+    dutydb = DutyDB()
+    parsigdb = ParSigDB(threshold=t)
+    sigagg = SigAgg(
+        threshold=t, fork=fork, slots_per_epoch=config.slots_per_epoch
+    )
+    aggsigdb = AggSigDB()
+    bcast = Broadcaster(beacon=beacon, clock=clock)
+    fetcher = Fetcher(beacon)
+    consensus = ConsensusController(
+        QBFTConsensus(qbft_net, n)
+    )
+    vapi = ValidatorAPI(
+        share_idx=share_idx,
+        pubshares=pubshares_by_idx[share_idx],
+        fork=fork,
+        slots_per_epoch=config.slots_per_epoch,
+    )
+    verifier = Eth2Verifier(fork, pubshares_by_idx, config.slots_per_epoch)
+    parsigex = ParSigEx(share_idx, parsig_transport, verifier)
+    scheduler = Scheduler(
+        beacon,
+        clock,
+        validators,
+        slots_per_epoch=config.slots_per_epoch,
+    )
+    tracker = Tracker(peer_share_indices=list(range(1, n + 1)))
+
+    wire(
+        scheduler=scheduler,
+        fetcher=fetcher,
+        consensus=consensus,
+        dutydb=dutydb,
+        validatorapi=vapi,
+        parsigdb=parsigdb,
+        parsigex=parsigex,
+        sigagg=sigagg,
+        aggsigdb=aggsigdb,
+        broadcaster=bcast,
+        options=[tracking(tracker)],
+    )
+
+    # deadliner trims stores + triggers tracker analysis
+    deadliner = Deadliner(clock, _make_expiry(dutydb, parsigdb, aggsigdb, tracker))
+    scheduler.subscribe_duties(_register_deadline(deadliner))
+
+    vapi_router = VapiRouter(vapi)
+
+    # -- lifecycle hooks --------------------------------------------------
+    async def start_vapi():
+        port = await vapi_router.start("127.0.0.1", config.validator_api_port)
+        log.info("validator api listening", topic="vapi", port=port)
+
+    life.register_start(Order.VALIDATOR_API, "vapi", start_vapi, background=False)
+    life.register_stop(Order.VALIDATOR_API, "vapi", vapi_router.stop)
+    life.register_start(
+        Order.DEADLINER,
+        "deadliner",
+        _async_noop(deadliner.start),
+        background=False,
+    )
+    life.register_stop(Order.DEADLINER, "deadliner", deadliner.stop)
+    life.register_start(Order.SCHEDULER, "scheduler", scheduler.run)
+
+    async def stop_sched():
+        scheduler.stop()
+
+    life.register_stop(Order.SCHEDULER, "scheduler", stop_sched)
+
+    if config.monitoring_port:
+        async def start_mon():
+            await serve_monitoring(
+                "127.0.0.1", config.monitoring_port, metrics
+            )
+
+        life.register_start(Order.MONITORING, "monitoring", start_mon, background=False)
+
+    return Node(
+        config=config,
+        lock=lock,
+        life=life,
+        scheduler=scheduler,
+        vapi=vapi,
+        vapi_router=vapi_router,
+        p2p=p2p_node,
+        bcast=bcast,
+        tracker=tracker,
+        metrics=metrics,
+        beacon=beacon,
+    )
+
+
+def _make_expiry(dutydb, parsigdb, aggsigdb, tracker):
+    async def on_expired(duty):
+        dutydb.trim(duty)
+        parsigdb.trim(duty)
+        aggsigdb.trim(duty)
+        await tracker.duty_expired(duty)
+
+    return on_expired
+
+
+def _register_deadline(deadliner):
+    async def on_duty(duty, defs):
+        deadliner.add(duty)
+
+    return on_duty
+
+
+def _async_noop(fn):
+    async def run():
+        fn()
+
+    return run
+
+
+async def run(config: Config, stop: asyncio.Event | None = None) -> None:
+    """ref: app.Run (app/app.go:131) — build then run the lifecycle."""
+    node = await build_node(config)
+    await node.life.run(stop)

@@ -1,0 +1,1 @@
+"""CLI entry points (ref: cmd/ — cobra commands; argparse here)."""

@@ -1,0 +1,187 @@
+"""charon-tpu command line interface.
+
+Mirrors ref: cmd/cmd.go:72 — subcommands: run, dkg, create cluster,
+enr, version (the reference's cobra tree; argparse here, flags also bound
+to CHARON_TPU_* environment variables like the reference's viper
+binding, ref: cmd/run.go:50).
+
+    python -m charon_tpu.cmd.cli create-cluster --name test --nodes 4 \
+        --threshold 3 --validators 2 --output-dir ./cluster
+    python -m charon_tpu.cmd.cli run --data-dir ./cluster/node0 --simnet
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+from pathlib import Path
+
+
+def _env_default(name: str, default=None):
+    return os.environ.get(f"CHARON_TPU_{name.upper().replace('-', '_')}", default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="charon-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    runp = sub.add_parser("run", help="run the distributed validator node")
+    runp.add_argument("--data-dir", default=_env_default("data-dir", ".charon"))
+    runp.add_argument("--node-index", type=int, default=int(_env_default("node-index", 0)))
+    runp.add_argument("--simnet", action="store_true")
+    runp.add_argument("--validator-api-port", type=int, default=int(_env_default("validator-api-port", 3600)))
+    runp.add_argument("--monitoring-port", type=int, default=int(_env_default("monitoring-port", 3620)))
+    runp.add_argument("--p2p-port", type=int, default=int(_env_default("p2p-port", 3610)))
+    runp.add_argument("--slot-duration", type=float, default=float(_env_default("slot-duration", 12.0)))
+    runp.add_argument(
+        "--peers",
+        default=_env_default("peers", ""),
+        help="comma-separated host:port per operator (index order)",
+    )
+    runp.add_argument("--no-tpu", action="store_true", help="use the pure-python tbls backend")
+
+    create = sub.add_parser(
+        "create-cluster",
+        help="generate a full cluster locally (keys, lock, node dirs)",
+    )
+    create.add_argument("--name", default="charon-tpu-cluster")
+    create.add_argument("--nodes", type=int, default=4)
+    create.add_argument("--threshold", type=int, default=3)
+    create.add_argument("--validators", type=int, default=1)
+    create.add_argument("--fork-version", default="0x00000000")
+    create.add_argument("--output-dir", required=True)
+
+    dkgp = sub.add_parser("dkg", help="run the distributed key generation ceremony")
+    dkgp.add_argument("--definition-file", required=True)
+    dkgp.add_argument("--data-dir", required=True)
+    dkgp.add_argument("--node-index", type=int, required=True)
+
+    enrp = sub.add_parser("enr", help="print this node's identity record")
+    enrp.add_argument("--data-dir", default=".charon")
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def cmd_create_cluster(args) -> int:
+    """ref: cmd/createcluster.go — an in-memory ceremony producing every
+    node's directory (lock + keystores + p2p key)."""
+    from charon_tpu.app import k1util
+    from charon_tpu.cluster.definition import ClusterDefinition, Operator
+    from charon_tpu.dkg import frost
+    from charon_tpu.dkg.ceremony import MemExchangeNet, run_dkg
+
+    n, t, v = args.nodes, args.threshold, args.validators
+    out = Path(args.output_dir)
+    keys = [k1util.generate_private_key() for _ in range(n)]
+    ops = tuple(
+        Operator(
+            address=f"operator-{i}",
+            enr="enr:node-%d:%s"
+            % (i, k1util.public_key_to_bytes(keys[i].public_key()).hex()),
+        )
+        for i in range(n)
+    )
+    defn = ClusterDefinition(
+        name=args.name,
+        num_validators=v,
+        threshold=t,
+        fork_version=args.fork_version,
+        operators=ops,
+    )
+    for i in range(n):
+        defn = defn.sign_operator(i, keys[i])
+
+    async def ceremony():
+        fnet = frost.MemFrostTransport(n)
+        xnet = MemExchangeNet(n)
+        return await asyncio.gather(
+            *(
+                run_dkg(
+                    defn,
+                    i,
+                    keys[i],
+                    fnet.participant(i + 1),
+                    xnet.port(i),
+                    data_dir=out / f"node{i}",
+                )
+                for i in range(n)
+            )
+        )
+
+    results = asyncio.run(ceremony())
+    for i in range(n):
+        (out / f"node{i}" / "charon-enr-private-key").write_bytes(
+            k1util.private_key_to_bytes(keys[i])
+        )
+    (out / "cluster-definition.json").write_text(
+        json.dumps(defn.to_json(), indent=2)
+    )
+    print(f"created {n}-node cluster (threshold {t}, {v} validators) in {out}")
+    print(f"lock hash: 0x{results[0].lock.lock_hash().hex()}")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from charon_tpu.app.run import Config, run
+
+    peer_addrs = []
+    if args.peers:
+        for part in args.peers.split(","):
+            host, port = part.rsplit(":", 1)
+            peer_addrs.append((host, int(port)))
+    config = Config(
+        data_dir=args.data_dir,
+        node_index=args.node_index,
+        validator_api_port=args.validator_api_port,
+        monitoring_port=args.monitoring_port,
+        p2p_port=args.p2p_port,
+        peer_addrs=peer_addrs,
+        simnet=args.simnet,
+        slot_duration=args.slot_duration,
+        use_tpu_tbls=not args.no_tpu,
+    )
+    asyncio.run(run(config))
+    return 0
+
+
+def cmd_dkg(args) -> int:
+    # The multi-process TCP DKG transport lands with the networked
+    # ceremony; single-process ceremonies use create-cluster.
+    print(
+        "networked dkg not yet wired to TCP transports; "
+        "use create-cluster for local ceremonies",
+        file=sys.stderr,
+    )
+    return 1
+
+
+def cmd_enr(args) -> int:
+    from charon_tpu.app import k1util
+
+    key_path = Path(args.data_dir) / "charon-enr-private-key"
+    key = k1util.private_key_from_bytes(key_path.read_bytes())
+    print("enr:" + k1util.public_key_to_bytes(key.public_key()).hex())
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        from charon_tpu import __version__
+
+        print(f"charon-tpu {__version__}")
+        return 0
+    return {
+        "run": cmd_run,
+        "create-cluster": cmd_create_cluster,
+        "dkg": cmd_dkg,
+        "enr": cmd_enr,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
