@@ -1,0 +1,1055 @@
+// charon-tpu native host BLS12-381 backend.
+//
+// Plays the role herumi/bls-eth-go-binary plays in the reference (the only
+// native component there — ref: go.mod herumi, tbls/herumi.go wrapper):
+// a fast C++ implementation of the 11-op tbls surface for the host path,
+// validated bit-for-bit against the Python specification
+// (charon_tpu/crypto/*) by tests/test_native_backend.py.
+//
+// Algorithms mirror the Python spec exactly:
+//   fields.py        -> Fp/Fp2/Fp6/Fp12 tower (Montgomery, 6x64 CIOS)
+//   g1g2.py          -> Jacobian curve arithmetic + ZCash serialization
+//   pairing_fast.py  -> projective Miller loop w/ sparse lines, x-chain
+//                       final exponentiation (computes e(.,.)^3 — sound
+//                       for product==1 checks)
+//   h2c.py           -> RFC 9380 hash-to-curve for G2 (SHA-256 XMD)
+//   shamir.py        -> Fr Lagrange recombination
+//
+// Build: make -C native   (produces libcharon_native.so; loaded via ctypes
+// by charon_tpu/tbls/native_impl.py)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+#include "constants.h"
+
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------------
+// Generic N-limb Montgomery field
+// ---------------------------------------------------------------------------
+
+template <int N>
+struct Mont {
+    const uint64_t *mod, *r2, *one;
+    uint64_t ninv;
+
+    void add(uint64_t* o, const uint64_t* a, const uint64_t* b) const {
+        u128 c = 0;
+        for (int i = 0; i < N; i++) { c += (u128)a[i] + b[i]; o[i] = (uint64_t)c; c >>= 64; }
+        cond_sub(o, (uint64_t)c);
+    }
+    void sub(uint64_t* o, const uint64_t* a, const uint64_t* b) const {
+        unsigned char borrow = 0;
+        u128 c = 0;
+        for (int i = 0; i < N; i++) {
+            u128 d = (u128)a[i] - b[i] - (uint64_t)borrow;
+            o[i] = (uint64_t)d;
+            borrow = (d >> 64) != 0;
+        }
+        if (borrow) {
+            c = 0;
+            for (int i = 0; i < N; i++) { c += (u128)o[i] + mod[i]; o[i] = (uint64_t)c; c >>= 64; }
+        }
+    }
+    void neg(uint64_t* o, const uint64_t* a) const {
+        uint64_t z[N] = {0};
+        sub(o, z, a);
+    }
+    bool is_zero(const uint64_t* a) const {
+        uint64_t acc = 0;
+        for (int i = 0; i < N; i++) acc |= a[i];
+        return acc == 0;
+    }
+    bool eq(const uint64_t* a, const uint64_t* b) const {
+        uint64_t acc = 0;
+        for (int i = 0; i < N; i++) acc |= a[i] ^ b[i];
+        return acc == 0;
+    }
+    bool geq_mod(const uint64_t* a) const {
+        for (int i = N - 1; i >= 0; i--) {
+            if (a[i] > mod[i]) return true;
+            if (a[i] < mod[i]) return false;
+        }
+        return true;
+    }
+    void cond_sub(uint64_t* a, uint64_t hi) const {
+        if (hi || geq_mod(a)) {
+            unsigned char borrow = 0;
+            for (int i = 0; i < N; i++) {
+                u128 d = (u128)a[i] - mod[i] - borrow;
+                a[i] = (uint64_t)d;
+                borrow = (d >> 64) != 0;
+            }
+        }
+    }
+    // CIOS Montgomery multiplication.
+    void mul(uint64_t* o, const uint64_t* a, const uint64_t* b) const {
+        uint64_t t[N + 2] = {0};
+        for (int i = 0; i < N; i++) {
+            u128 c = 0;
+            for (int j = 0; j < N; j++) {
+                c += (u128)t[j] + (u128)a[j] * b[i];
+                t[j] = (uint64_t)c; c >>= 64;
+            }
+            c += t[N]; t[N] = (uint64_t)c; t[N + 1] = (uint64_t)(c >> 64);
+            uint64_t m = t[0] * ninv;
+            c = (u128)t[0] + (u128)m * mod[0];
+            c >>= 64;
+            for (int j = 1; j < N; j++) {
+                c += (u128)t[j] + (u128)m * mod[j];
+                t[j - 1] = (uint64_t)c; c >>= 64;
+            }
+            c += t[N]; t[N - 1] = (uint64_t)c;
+            t[N] = t[N + 1] + (uint64_t)(c >> 64);
+            t[N + 1] = 0;
+        }
+        for (int i = 0; i < N; i++) o[i] = t[i];
+        cond_sub(o, t[N]);
+    }
+    void sqr(uint64_t* o, const uint64_t* a) const { mul(o, a, a); }
+    void to_mont(uint64_t* o, const uint64_t* a) const { mul(o, a, r2); }
+    void from_mont(uint64_t* o, const uint64_t* a) const {
+        uint64_t u[N] = {0}; u[0] = 1;
+        mul(o, a, u);
+    }
+    // o = a^e for an N-limb exponent (raw, little-endian limbs), MSB-first.
+    void pow(uint64_t* o, const uint64_t* a, const uint64_t* e, int en) const {
+        uint64_t acc[N];
+        memcpy(acc, one, sizeof(acc));
+        bool started = false;
+        for (int i = en - 1; i >= 0; i--) {
+            for (int b = 63; b >= 0; b--) {
+                if (started) sqr(acc, acc);
+                if ((e[i] >> b) & 1) {
+                    if (started) mul(acc, acc, a);
+                    else { memcpy(acc, a, sizeof(acc)); started = true; }
+                }
+            }
+        }
+        memcpy(o, acc, sizeof(acc));
+    }
+    void inv(uint64_t* o, const uint64_t* a, const uint64_t* pm2) const {
+        pow(o, a, pm2, N);
+    }
+};
+
+static Mont<6> FP = { FP_MOD, FP_R2, FP_RONE, FP_NINV };
+static Mont<4> FR = { FR_MOD, FR_R2, FR_RONE, FR_NINV };
+
+struct Fp { uint64_t l[6]; };
+static inline Fp fadd(const Fp& a, const Fp& b) { Fp o; FP.add(o.l, a.l, b.l); return o; }
+static inline Fp fsub(const Fp& a, const Fp& b) { Fp o; FP.sub(o.l, a.l, b.l); return o; }
+static inline Fp fmul(const Fp& a, const Fp& b) { Fp o; FP.mul(o.l, a.l, b.l); return o; }
+static inline Fp fsqr(const Fp& a) { Fp o; FP.sqr(o.l, a.l); return o; }
+static inline Fp fneg(const Fp& a) { Fp o; FP.neg(o.l, a.l); return o; }
+static inline Fp fdbl(const Fp& a) { return fadd(a, a); }
+static inline bool fzero(const Fp& a) { return FP.is_zero(a.l); }
+static inline bool feq(const Fp& a, const Fp& b) { return FP.eq(a.l, b.l); }
+static inline Fp finv(const Fp& a) { Fp o; FP.pow(o.l, a.l, FP_PM2, 6); return o; }
+static Fp FP_ZERO_V = {{0,0,0,0,0,0}};
+static Fp fp_one() { Fp o; memcpy(o.l, FP_RONE, 48); return o; }
+
+// ---------------------------------------------------------------------------
+// Fp2 = Fp[u]/(u^2+1)
+// ---------------------------------------------------------------------------
+
+struct Fp2 { Fp c0, c1; };
+
+static inline Fp2 f2add(const Fp2& a, const Fp2& b) { return { fadd(a.c0,b.c0), fadd(a.c1,b.c1) }; }
+static inline Fp2 f2sub(const Fp2& a, const Fp2& b) { return { fsub(a.c0,b.c0), fsub(a.c1,b.c1) }; }
+static inline Fp2 f2neg(const Fp2& a) { return { fneg(a.c0), fneg(a.c1) }; }
+static inline Fp2 f2dbl(const Fp2& a) { return f2add(a, a); }
+static inline bool f2zero(const Fp2& a) { return fzero(a.c0) && fzero(a.c1); }
+static inline bool f2eq(const Fp2& a, const Fp2& b) { return feq(a.c0,b.c0) && feq(a.c1,b.c1); }
+static inline Fp2 f2mul(const Fp2& a, const Fp2& b) {
+    Fp v0 = fmul(a.c0, b.c0), v1 = fmul(a.c1, b.c1);
+    Fp s = fmul(fadd(a.c0, a.c1), fadd(b.c0, b.c1));
+    return { fsub(v0, v1), fsub(fsub(s, v0), v1) };
+}
+static inline Fp2 f2sqr(const Fp2& a) {
+    Fp t0 = fmul(fadd(a.c0, a.c1), fsub(a.c0, a.c1));
+    Fp t1 = fdbl(fmul(a.c0, a.c1));
+    return { t0, t1 };
+}
+static inline Fp2 f2conj(const Fp2& a) { return { a.c0, fneg(a.c1) }; }
+static inline Fp2 f2muxi(const Fp2& a) {  // * (1+u)
+    return { fsub(a.c0, a.c1), fadd(a.c0, a.c1) };
+}
+static inline Fp2 f2small(const Fp2& a, int k) {
+    Fp2 acc; bool has = false; Fp2 add = a;
+    while (k) {
+        if (k & 1) { acc = has ? f2add(acc, add) : add; has = true; }
+        k >>= 1;
+        if (k) add = f2dbl(add);
+    }
+    return acc;
+}
+static inline Fp2 f2inv(const Fp2& a) {
+    Fp norm = fadd(fsqr(a.c0), fsqr(a.c1));
+    Fp ni = finv(norm);
+    return { fmul(a.c0, ni), fneg(fmul(a.c1, ni)) };
+}
+static inline Fp2 f2mul_fp(const Fp2& a, const Fp& s) { return { fmul(a.c0, s), fmul(a.c1, s) }; }
+static Fp2 f2_zero() { return { FP_ZERO_V, FP_ZERO_V }; }
+static Fp2 f2_one() { return { fp_one(), FP_ZERO_V }; }
+
+// Fp2 pow by raw big exponent (little-endian 64-bit limbs)
+static Fp2 f2pow(const Fp2& a, const uint64_t* e, int en) {
+    Fp2 acc = f2_one(); bool started = false;
+    for (int i = en - 1; i >= 0; i--)
+        for (int b = 63; b >= 0; b--) {
+            if (started) acc = f2sqr(acc);
+            if ((e[i] >> b) & 1) {
+                if (started) acc = f2mul(acc, a);
+                else { acc = a; started = true; }
+            }
+        }
+    return started ? acc : f2_one();
+}
+
+// sqrt in Fp2 (p ≡ 3 mod 4, Adj–Rodríguez; spec: fields.py fp2_sqrt)
+static bool f2sqrt(const Fp2& a, Fp2* out) {
+    if (f2zero(a)) { *out = f2_zero(); return true; }
+    Fp2 a1 = f2pow(a, FP_P34, 6);
+    Fp2 x0 = f2mul(a1, a);
+    Fp2 alpha = f2mul(a1, x0);
+    Fp2 cand;
+    Fp2 neg1 = { fneg(fp_one()), FP_ZERO_V };
+    if (f2eq(alpha, neg1)) {
+        cand = { fneg(x0.c1), x0.c0 };  // u * x0
+    } else {
+        Fp2 b = f2pow(f2add(f2_one(), alpha), FP_P12, 6);
+        cand = f2mul(b, x0);
+    }
+    if (!f2eq(f2sqr(cand), a)) return false;
+    *out = cand;
+    return true;
+}
+
+static bool f2_is_square(const Fp2& a) {
+    if (f2zero(a)) return true;
+    Fp norm = fadd(fsqr(a.c0), fsqr(a.c1));
+    Fp r; FP.pow(r.l, norm.l, FP_P12, 6);
+    return feq(r, fp_one());
+}
+
+// RFC 9380 sgn0 for Fp2 (needs raw form LSB + zero check)
+static int f2sgn0(const Fp2& a) {
+    uint64_t r0[6], r1[6];
+    FP.from_mont(r0, a.c0.l);
+    FP.from_mont(r1, a.c1.l);
+    int sign0 = r0[0] & 1;
+    uint64_t z = 0; for (int i = 0; i < 6; i++) z |= r0[i];
+    int zero0 = (z == 0);
+    int sign1 = r1[0] & 1;
+    return sign0 | (zero0 & sign1);
+}
+
+// ZCash lexicographic "largest" for Fp2 y-coordinate (spec: fields.py)
+static bool fp_is_larger_half(const uint64_t* raw) {
+    // compare raw > (p-1)/2  i.e. raw >= (p+1)/2 — compute (p-1)/2 on the fly
+    static uint64_t half[6]; static bool init = false;
+    if (!init) {
+        uint64_t borrow = 0; (void)borrow;
+        uint64_t tmp[6];
+        // (p-1)/2: p is odd
+        uint64_t carry = 0;
+        for (int i = 5; i >= 0; i--) {
+            uint64_t v = FP_MOD[i];
+            tmp[i] = (v >> 1) | (carry << 63);
+            carry = v & 1;
+        }
+        memcpy(half, tmp, sizeof(tmp));
+        init = true;
+    }
+    for (int i = 5; i >= 0; i--) {
+        if (raw[i] > half[i]) return true;
+        if (raw[i] < half[i]) return false;
+    }
+    return false; // equal to (p-1)/2 -> not larger
+}
+
+static bool f2_is_lex_largest(const Fp2& y) {
+    uint64_t r0[6], r1[6];
+    FP.from_mont(r1, y.c1.l);
+    uint64_t z1 = 0; for (int i = 0; i < 6; i++) z1 |= r1[i];
+    if (z1 != 0) return fp_is_larger_half(r1);
+    FP.from_mont(r0, y.c0.l);
+    return fp_is_larger_half(r0);
+}
+
+static bool fp_is_lex_largest(const Fp& y) {
+    uint64_t r[6];
+    FP.from_mont(r, y.l);
+    return fp_is_larger_half(r);
+}
+
+// ---------------------------------------------------------------------------
+// Fp6 / Fp12 (spec: fields.py)
+// ---------------------------------------------------------------------------
+
+struct Fp6 { Fp2 c0, c1, c2; };
+struct Fp12 { Fp6 c0, c1; };
+
+static inline Fp6 f6add(const Fp6& a, const Fp6& b) { return { f2add(a.c0,b.c0), f2add(a.c1,b.c1), f2add(a.c2,b.c2) }; }
+static inline Fp6 f6sub(const Fp6& a, const Fp6& b) { return { f2sub(a.c0,b.c0), f2sub(a.c1,b.c1), f2sub(a.c2,b.c2) }; }
+static inline Fp6 f6neg(const Fp6& a) { return { f2neg(a.c0), f2neg(a.c1), f2neg(a.c2) }; }
+static Fp6 f6mul(const Fp6& a, const Fp6& b) {
+    Fp2 t00 = f2mul(a.c0,b.c0), t11 = f2mul(a.c1,b.c1), t22 = f2mul(a.c2,b.c2);
+    Fp2 c0 = f2add(t00, f2muxi(f2add(f2mul(a.c1,b.c2), f2mul(a.c2,b.c1))));
+    Fp2 c1 = f2add(f2add(f2mul(a.c0,b.c1), f2mul(a.c1,b.c0)), f2muxi(t22));
+    Fp2 c2 = f2add(f2add(f2mul(a.c0,b.c2), f2mul(a.c2,b.c0)), t11);
+    return { c0, c1, c2 };
+}
+static inline Fp6 f6sqr(const Fp6& a) { return f6mul(a, a); }
+static inline Fp6 f6mul_v(const Fp6& a) { return { f2muxi(a.c2), a.c0, a.c1 }; }
+static Fp6 f6inv(const Fp6& a) {
+    Fp2 t0 = f2sub(f2sqr(a.c0), f2muxi(f2mul(a.c1, a.c2)));
+    Fp2 t1 = f2sub(f2muxi(f2sqr(a.c2)), f2mul(a.c0, a.c1));
+    Fp2 t2 = f2sub(f2sqr(a.c1), f2mul(a.c0, a.c2));
+    Fp2 d = f2add(f2mul(a.c0, t0), f2muxi(f2add(f2mul(a.c2, t1), f2mul(a.c1, t2))));
+    Fp2 di = f2inv(d);
+    return { f2mul(t0, di), f2mul(t1, di), f2mul(t2, di) };
+}
+static Fp6 f6_zero() { return { f2_zero(), f2_zero(), f2_zero() }; }
+static Fp6 f6_one() { return { f2_one(), f2_zero(), f2_zero() }; }
+
+static Fp12 f12mul(const Fp12& a, const Fp12& b) {
+    Fp6 t0 = f6mul(a.c0, b.c0), t1 = f6mul(a.c1, b.c1);
+    Fp6 c0 = f6add(t0, f6mul_v(t1));
+    Fp6 c1 = f6add(f6mul(a.c0, b.c1), f6mul(a.c1, b.c0));
+    return { c0, c1 };
+}
+static inline Fp12 f12sqr(const Fp12& a) { return f12mul(a, a); }
+static inline Fp12 f12conj(const Fp12& a) { return { a.c0, f6neg(a.c1) }; }
+static Fp12 f12inv(const Fp12& a) {
+    Fp6 d = f6sub(f6sqr(a.c0), f6mul_v(f6sqr(a.c1)));
+    Fp6 di = f6inv(d);
+    return { f6mul(a.c0, di), f6neg(f6mul(a.c1, di)) };
+}
+static Fp12 f12_one() { return { f6_one(), f6_zero() }; }
+static bool f12_is_one(const Fp12& a) {
+    return f2eq(a.c0.c0, f2_one()) && f2zero(a.c0.c1) && f2zero(a.c0.c2)
+        && f2zero(a.c1.c0) && f2zero(a.c1.c1) && f2zero(a.c1.c2);
+}
+
+// Frobenius: gamma6 = xi^((p-1)/6) computed once at init.
+static Fp2 GAMMA[6];
+static void init_frobenius() {
+    // exponent (p-1)/6
+    uint64_t e[6];
+    uint64_t carry = 0;
+    // (p-1) then divide by 6 via schoolbook
+    uint64_t pm1[6];
+    memcpy(pm1, FP_MOD, 48); pm1[0] -= 1;
+    u128 rem = 0;
+    for (int i = 5; i >= 0; i--) {
+        u128 cur = (rem << 64) | pm1[i];
+        e[i] = (uint64_t)(cur / 6);
+        rem = cur % 6;
+    }
+    (void)carry;
+    Fp2 xi = { fp_one(), fp_one() };
+    Fp2 g = f2pow(xi, e, 6);
+    GAMMA[0] = f2_one();
+    for (int i = 1; i < 6; i++) GAMMA[i] = f2mul(GAMMA[i-1], g);
+}
+static Fp12 f12frob(const Fp12& a) {
+    Fp12 o;
+    const Fp2* in[2][3] = { { &a.c0.c0, &a.c0.c1, &a.c0.c2 }, { &a.c1.c0, &a.c1.c1, &a.c1.c2 } };
+    Fp2* out[2][3] = { { &o.c0.c0, &o.c0.c1, &o.c0.c2 }, { &o.c1.c0, &o.c1.c1, &o.c1.c2 } };
+    for (int i = 0; i < 2; i++)
+        for (int j = 0; j < 3; j++) {
+            Fp2 c = f2conj(*in[i][j]);
+            int k = 2 * j + i;
+            *out[i][j] = k ? f2mul(c, GAMMA[k]) : c;
+        }
+    return o;
+}
+static Fp12 f12frob2(const Fp12& a) { return f12frob(f12frob(a)); }
+
+// ---------------------------------------------------------------------------
+// Curve points (Jacobian; spec: g1g2.py _jac_*)
+// ---------------------------------------------------------------------------
+
+template <typename F>
+struct Jac { F x, y, z; };
+
+struct FpOps {
+    typedef Fp T;
+    static T add(const T&a,const T&b){return fadd(a,b);} static T sub(const T&a,const T&b){return fsub(a,b);}
+    static T mul(const T&a,const T&b){return fmul(a,b);} static T sqr(const T&a){return fsqr(a);}
+    static T neg(const T&a){return fneg(a);} static T inv(const T&a){return finv(a);}
+    static bool zero(const T&a){return fzero(a);} static bool eq(const T&a,const T&b){return feq(a,b);}
+    static T zero_v(){return FP_ZERO_V;} static T one_v(){return fp_one();}
+};
+struct Fp2Ops {
+    typedef Fp2 T;
+    static T add(const T&a,const T&b){return f2add(a,b);} static T sub(const T&a,const T&b){return f2sub(a,b);}
+    static T mul(const T&a,const T&b){return f2mul(a,b);} static T sqr(const T&a){return f2sqr(a);}
+    static T neg(const T&a){return f2neg(a);} static T inv(const T&a){return f2inv(a);}
+    static bool zero(const T&a){return f2zero(a);} static bool eq(const T&a,const T&b){return f2eq(a,b);}
+    static T zero_v(){return f2_zero();} static T one_v(){return f2_one();}
+};
+
+template <typename O>
+static Jac<typename O::T> jac_double(const Jac<typename O::T>& p) {
+    typedef typename O::T T;
+    if (O::zero(p.z)) return p;
+    T a = O::sqr(p.x), b = O::sqr(p.y), c = O::sqr(b);
+    T d = O::sub(O::sub(O::sqr(O::add(p.x, b)), a), c);
+    d = O::add(d, d);
+    T e = O::add(O::add(a, a), a);
+    T f = O::sqr(e);
+    T x3 = O::sub(f, O::add(d, d));
+    T c8 = O::add(O::add(c, c), O::add(c, c)); c8 = O::add(c8, c8);
+    T y3 = O::sub(O::mul(e, O::sub(d, x3)), c8);
+    T z3 = O::mul(O::add(p.y, p.y), p.z);
+    return { x3, y3, z3 };
+}
+
+template <typename O>
+static Jac<typename O::T> jac_add_affine(const Jac<typename O::T>& p, const typename O::T& qx, const typename O::T& qy) {
+    typedef typename O::T T;
+    if (O::zero(p.z)) return { qx, qy, O::one_v() };
+    T zz = O::sqr(p.z);
+    T u2 = O::mul(qx, zz);
+    T s2 = O::mul(O::mul(qy, p.z), zz);
+    if (O::eq(u2, p.x)) {
+        if (O::eq(s2, p.y)) return jac_double<O>(p);
+        return { O::zero_v(), O::zero_v(), O::zero_v() };
+    }
+    T h = O::sub(u2, p.x);
+    T hh = O::sqr(h);
+    T i = O::add(O::add(hh, hh), O::add(hh, hh));
+    T j = O::mul(h, i);
+    T r = O::sub(s2, p.y); r = O::add(r, r);
+    T v = O::mul(p.x, i);
+    T x3 = O::sub(O::sub(O::sqr(r), j), O::add(v, v));
+    T yj = O::mul(p.y, j);
+    T y3 = O::sub(O::mul(r, O::sub(v, x3)), O::add(yj, yj));
+    T z3 = O::sub(O::sub(O::sqr(O::add(p.z, h)), zz), hh);
+    return { x3, y3, z3 };
+}
+
+// Scalar multiply (var-time, public data) by raw little-endian limbs.
+template <typename O>
+static Jac<typename O::T> jac_mul(const typename O::T& px, const typename O::T& py, const uint64_t* k, int kn) {
+    Jac<typename O::T> acc = { O::zero_v(), O::zero_v(), O::zero_v() };
+    bool any = false;
+    for (int i = kn - 1; i >= 0; i--)
+        for (int b = 63; b >= 0; b--) {
+            if (any) acc = jac_double<O>(acc);
+            if ((k[i] >> b) & 1) { acc = jac_add_affine<O>(acc, px, py); any = true; }
+        }
+    return acc;
+}
+
+template <typename O>
+static bool jac_to_affine(const Jac<typename O::T>& p, typename O::T* ox, typename O::T* oy) {
+    if (O::zero(p.z)) return false;  // infinity
+    typename O::T zi = O::inv(p.z);
+    typename O::T zi2 = O::sqr(zi);
+    *ox = O::mul(p.x, zi2);
+    *oy = O::mul(O::mul(p.y, zi2), zi);
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// Pairing (spec: pairing_fast.py — identical formulas)
+// ---------------------------------------------------------------------------
+
+struct G2Proj { Fp2 x, y, z; };
+
+static void dbl_step(G2Proj& t, const Fp& xp, const Fp& yp, Fp2 l[3]) {
+    Fp2 w = f2small(f2sqr(t.x), 3);
+    Fp2 s = f2mul(t.y, t.z);
+    Fp2 bb = f2mul(f2mul(t.x, t.y), s);
+    Fp2 h = f2sub(f2sqr(w), f2small(bb, 8));
+    Fp2 y2 = f2sqr(t.y);
+    Fp2 x3 = f2small(f2mul(h, s), 2);
+    Fp2 y3 = f2sub(f2mul(w, f2sub(f2small(bb, 4), h)), f2small(f2mul(y2, f2sqr(s)), 8));
+    Fp2 z3 = f2small(f2mul(s, f2sqr(s)), 8);
+    l[0] = f2muxi(f2mul_fp(f2mul(s, t.z), fdbl(yp)));
+    l[1] = f2sub(f2mul(w, t.x), f2small(f2mul(y2, t.z), 2));
+    l[2] = f2mul_fp(f2mul(w, t.z), fneg(xp));
+    t = { x3, y3, z3 };
+}
+
+static void add_step(G2Proj& t, const Fp2& qx, const Fp2& qy, const Fp& xp, const Fp& yp, Fp2 l[3]) {
+    Fp2 theta = f2sub(t.y, f2mul(qy, t.z));
+    Fp2 lam = f2sub(t.x, f2mul(qx, t.z));
+    Fp2 lam2 = f2sqr(lam);
+    Fp2 lam3 = f2mul(lam2, lam);
+    Fp2 ww = f2add(f2sub(f2mul(f2sqr(theta), t.z), f2mul(lam2, f2dbl(t.x))), lam3);
+    Fp2 x3 = f2mul(lam, ww);
+    Fp2 y3 = f2sub(f2mul(theta, f2sub(f2mul(lam2, t.x), ww)), f2mul(lam3, t.y));
+    Fp2 z3 = f2mul(lam3, t.z);
+    l[0] = f2muxi(f2mul_fp(lam, yp));
+    l[1] = f2sub(f2mul(theta, qx), f2mul(lam, qy));
+    l[2] = f2mul_fp(theta, fneg(xp));
+    t = { x3, y3, z3 };
+}
+
+static Fp12 mul_sparse_line(const Fp12& f, const Fp2 l[3]) {
+    const Fp2 &a0 = f.c0.c0, &a1 = f.c0.c1, &a2 = f.c0.c2;
+    const Fp2 &b0 = f.c1.c0, &b1 = f.c1.c1, &b2 = f.c1.c2;
+    const Fp2 &l0 = l[0], &l1 = l[1], &l2 = l[2];
+    Fp2 t0_0 = f2mul(a0, l0), t0_1 = f2mul(a1, l0), t0_2 = f2mul(a2, l0);
+    Fp2 t1_0 = f2muxi(f2add(f2mul(b1, l2), f2mul(b2, l1)));
+    Fp2 t1_1 = f2add(f2mul(b0, l1), f2muxi(f2mul(b2, l2)));
+    Fp2 t1_2 = f2add(f2mul(b0, l2), f2mul(b1, l1));
+    Fp2 c0_0 = f2add(t0_0, f2muxi(t1_2));
+    Fp2 c0_1 = f2add(t0_1, t1_0);
+    Fp2 c0_2 = f2add(t0_2, t1_1);
+    Fp2 al_0 = f2muxi(f2add(f2mul(a1, l2), f2mul(a2, l1)));
+    Fp2 al_1 = f2add(f2mul(a0, l1), f2muxi(f2mul(a2, l2)));
+    Fp2 al_2 = f2add(f2mul(a0, l2), f2mul(a1, l1));
+    Fp2 c1_0 = f2add(al_0, f2mul(b0, l0));
+    Fp2 c1_1 = f2add(al_1, f2mul(b1, l0));
+    Fp2 c1_2 = f2add(al_2, f2mul(b2, l0));
+    return { { c0_0, c0_1, c0_2 }, { c1_0, c1_1, c1_2 } };
+}
+
+// Product of Miller loops over up to MAXP pairs; skips dead pairs.
+static Fp12 miller_loop(int np, const Fp* px, const Fp* py, const Fp2* qx, const Fp2* qy, const bool* dead) {
+    G2Proj ts[8];
+    for (int k = 0; k < np; k++) ts[k] = { qx[k], qy[k], f2_one() };
+    Fp12 f = f12_one();
+    Fp2 line[3];
+    for (int i = 0; i < X_NBITS; i++) {
+        if (i) f = f12sqr(f);
+        for (int k = 0; k < np; k++) {
+            if (dead[k]) continue;
+            dbl_step(ts[k], px[k], py[k], line);
+            f = mul_sparse_line(f, line);
+        }
+        if (X_BITS[i]) {
+            for (int k = 0; k < np; k++) {
+                if (dead[k]) continue;
+                add_step(ts[k], qx[k], qy[k], px[k], py[k], line);
+                f = mul_sparse_line(f, line);
+            }
+        }
+    }
+    return f12conj(f);  // x < 0 for BLS12-381
+}
+
+// Granger–Scott cyclotomic square (spec: fptower.py fp12_cyclotomic_sqr)
+static Fp12 cyc_sqr(const Fp12& a) {
+    const Fp2 &c0 = a.c0.c0, &c1 = a.c0.c1, &c2 = a.c0.c2;
+    const Fp2 &c3 = a.c1.c0, &c4 = a.c1.c1, &c5 = a.c1.c2;
+    Fp2 t0 = f2sqr(c4), t1 = f2sqr(c0);
+    Fp2 t6 = f2sub(f2sqr(f2add(c4, c0)), f2add(t0, t1));
+    Fp2 t2 = f2sqr(c2), t3 = f2sqr(c3);
+    Fp2 t7 = f2sub(f2sqr(f2add(c2, c3)), f2add(t2, t3));
+    Fp2 t4 = f2sqr(c5), t5 = f2sqr(c1);
+    Fp2 t8 = f2muxi(f2sub(f2sqr(f2add(c5, c1)), f2add(t4, t5)));
+    t0 = f2add(f2muxi(t0), t1);
+    t2 = f2add(f2muxi(t2), t3);
+    t4 = f2add(f2muxi(t4), t5);
+    Fp12 o;
+    o.c0.c0 = f2sub(f2small(t0, 3), f2dbl(c0));
+    o.c0.c1 = f2sub(f2small(t2, 3), f2dbl(c1));
+    o.c0.c2 = f2sub(f2small(t4, 3), f2dbl(c2));
+    o.c1.c0 = f2add(f2small(t8, 3), f2dbl(c3));
+    o.c1.c1 = f2add(f2small(t6, 3), f2dbl(c4));
+    o.c1.c2 = f2add(f2small(t7, 3), f2dbl(c5));
+    return o;
+}
+
+static Fp12 cyc_pow_u(const Fp12& f) {  // f^|x|
+    Fp12 out = f;
+    for (int i = 0; i < X_NBITS; i++) {
+        out = cyc_sqr(out);
+        if (X_BITS[i]) out = f12mul(out, f);
+    }
+    return out;
+}
+static Fp12 cyc_pow_x(const Fp12& f) { return f12conj(cyc_pow_u(f)); }
+
+static Fp12 final_exp(const Fp12& fin) {  // f^(3(p^12-1)/r)
+    Fp12 f = f12mul(f12conj(fin), f12inv(fin));
+    Fp12 m = f12mul(f12frob2(f), f);
+    Fp12 a = f12mul(cyc_pow_u(m), m);
+    a = f12mul(cyc_pow_u(a), a);
+    Fp12 b = f12mul(cyc_pow_x(a), f12frob(a));
+    Fp12 c = f12mul(f12mul(cyc_pow_x(cyc_pow_x(b)), f12frob2(b)), f12conj(b));
+    return f12mul(c, f12mul(cyc_sqr(m), m));
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 (compact implementation from the FIPS 180-4 spec)
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+    uint32_t h[8];
+    uint8_t buf[64];
+    uint64_t len;
+    size_t fill;
+    static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+    void init() {
+        static const uint32_t iv[8] = {
+            0x6a09e667,0xbb67ae85,0x3c6ef372,0xa54ff53a,
+            0x510e527f,0x9b05688c,0x1f83d9ab,0x5be0cd19 };
+        memcpy(h, iv, sizeof(iv)); len = 0; fill = 0;
+    }
+    void block(const uint8_t* p) {
+        static const uint32_t K[64] = {
+            0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,0x923f82a4,0xab1c5ed5,
+            0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,
+            0xe49b69c1,0xefbe4786,0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+            0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,0x06ca6351,0x14292967,
+            0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,
+            0xa2bfe8a1,0xa81a664b,0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+            0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,0x5b9cca4f,0x682e6ff3,
+            0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2 };
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = (uint32_t)p[4*i]<<24 | (uint32_t)p[4*i+1]<<16 | (uint32_t)p[4*i+2]<<8 | p[4*i+3];
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr(w[i-15],7) ^ rotr(w[i-15],18) ^ (w[i-15]>>3);
+            uint32_t s1 = rotr(w[i-2],17) ^ rotr(w[i-2],19) ^ (w[i-2]>>10);
+            w[i] = w[i-16] + s0 + w[i-7] + s1;
+        }
+        uint32_t a=h[0],b=h[1],c=h[2],d=h[3],e=h[4],f=h[5],g=h[6],hh=h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = rotr(e,6)^rotr(e,11)^rotr(e,25);
+            uint32_t ch = (e&f)^((~e)&g);
+            uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+            uint32_t S0 = rotr(a,2)^rotr(a,13)^rotr(a,22);
+            uint32_t mj = (a&b)^(a&c)^(b&c);
+            uint32_t t2 = S0 + mj;
+            hh=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+        }
+        h[0]+=a;h[1]+=b;h[2]+=c;h[3]+=d;h[4]+=e;h[5]+=f;h[6]+=g;h[7]+=hh;
+    }
+    void update(const uint8_t* p, size_t n) {
+        len += n;
+        while (n) {
+            size_t take = 64 - fill; if (take > n) take = n;
+            memcpy(buf + fill, p, take);
+            fill += take; p += take; n -= take;
+            if (fill == 64) { block(buf); fill = 0; }
+        }
+    }
+    void final(uint8_t out[32]) {
+        uint64_t bits = len * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t z = 0;
+        while (fill != 56) update(&z, 1);
+        uint8_t lb[8];
+        for (int i = 0; i < 8; i++) lb[i] = (uint8_t)(bits >> (56 - 8*i));
+        update(lb, 8);
+        for (int i = 0; i < 8; i++) {
+            out[4*i] = (uint8_t)(h[i] >> 24); out[4*i+1] = (uint8_t)(h[i] >> 16);
+            out[4*i+2] = (uint8_t)(h[i] >> 8); out[4*i+3] = (uint8_t)h[i];
+        }
+    }
+};
+
+static void sha256(const uint8_t* p, size_t n, uint8_t out[32]) {
+    Sha256 s; s.init(); s.update(p, n); s.final(out);
+}
+
+// ---------------------------------------------------------------------------
+// hash-to-curve G2 (spec: h2c.py)
+// ---------------------------------------------------------------------------
+
+static const char DST[] = "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_";
+#define DST_LEN 43
+
+static void expand_xmd(const uint8_t* msg, size_t mlen, uint8_t* out, int len_in_bytes) {
+    int ell = (len_in_bytes + 31) / 32;
+    uint8_t dst_prime[DST_LEN + 1];
+    memcpy(dst_prime, DST, DST_LEN);
+    dst_prime[DST_LEN] = DST_LEN;
+    uint8_t b0[32];
+    {
+        Sha256 s; s.init();
+        uint8_t zpad[64] = {0};
+        s.update(zpad, 64);
+        s.update(msg, mlen);
+        uint8_t lib[3] = { (uint8_t)(len_in_bytes >> 8), (uint8_t)len_in_bytes, 0 };
+        s.update(lib, 3);
+        s.update(dst_prime, DST_LEN + 1);
+        s.final(b0);
+    }
+    uint8_t prev[32];
+    {
+        Sha256 s; s.init();
+        s.update(b0, 32);
+        uint8_t one = 1; s.update(&one, 1);
+        s.update(dst_prime, DST_LEN + 1);
+        s.final(prev);
+    }
+    int copied = 0;
+    memcpy(out, prev, (len_in_bytes - copied) < 32 ? (len_in_bytes - copied) : 32);
+    copied += 32;
+    for (int i = 2; i <= ell; i++) {
+        uint8_t x[32];
+        for (int j = 0; j < 32; j++) x[j] = b0[j] ^ prev[j];
+        Sha256 s; s.init();
+        s.update(x, 32);
+        uint8_t ib = (uint8_t)i; s.update(&ib, 1);
+        s.update(dst_prime, DST_LEN + 1);
+        s.final(prev);
+        int take = len_in_bytes - copied; if (take > 32) take = 32;
+        memcpy(out + copied, prev, take);
+        copied += take;
+    }
+}
+
+// 64-byte big-endian -> Fp (mod p), Montgomery form.
+static Fp fp_from_be64(const uint8_t* b) {
+    // value = hi*2^256 + lo, each 256-bit; reduce via Montgomery: we use
+    // pow-free approach: treat as 12 limbs and do schoolbook mod via
+    // repeated subtraction is too slow; instead: r = hi * 2^256 mod p via
+    // Montgomery mul with precomputed 2^256*R mod p... simpler: fold
+    // byte-by-byte: r = r*256 + byte (64 iterations of cheap ops).
+    Fp r = FP_ZERO_V;
+    Fp c256; {
+        uint64_t raw[6] = { 256, 0, 0, 0, 0, 0 };
+        FP.to_mont(c256.l, raw);
+    }
+    for (int i = 0; i < 64; i++) {
+        r = fmul(r, c256);
+        uint64_t raw[6] = { b[i], 0, 0, 0, 0, 0 };
+        Fp d; FP.to_mont(d.l, raw);
+        r = fadd(r, d);
+    }
+    return r;
+}
+
+struct G2Aff { Fp2 x, y; bool inf; };
+
+static void sswu(const Fp2& u, Fp2* ox, Fp2* oy) {
+    Fp2 A = { {{0}}, {{0}} }, B, Z;
+    memcpy(&A, SSWU_A, sizeof(A));
+    memcpy(&B, SSWU_B, sizeof(B));
+    memcpy(&Z, SSWU_Z, sizeof(Z));
+    Fp2 tv1 = f2mul(Z, f2sqr(u));
+    Fp2 tv2 = f2sqr(tv1);
+    Fp2 x1d = f2add(tv1, tv2);
+    Fp2 x1;
+    if (f2zero(x1d)) {
+        x1 = f2mul(B, f2inv(f2mul(Z, A)));
+    } else {
+        x1 = f2mul(f2mul(f2neg(B), f2inv(A)), f2add(f2_one(), f2inv(x1d)));
+    }
+    Fp2 gx1 = f2add(f2mul(f2add(f2sqr(x1), A), x1), B);
+    Fp2 x, y;
+    if (f2_is_square(gx1)) {
+        x = x1;
+        f2sqrt(gx1, &y);
+    } else {
+        x = f2mul(tv1, x1);
+        Fp2 gx2 = f2mul(gx1, f2mul(tv1, tv2));
+        f2sqrt(gx2, &y);
+    }
+    if (f2sgn0(u) != f2sgn0(y)) y = f2neg(y);
+    *ox = x; *oy = y;
+}
+
+static Fp2 horner(const uint64_t k[][2][6], int n, const Fp2& x) {
+    Fp2 acc; memcpy(&acc, k[n-1], sizeof(acc));
+    for (int i = n - 2; i >= 0; i--) {
+        Fp2 c; memcpy(&c, k[i], sizeof(c));
+        acc = f2add(f2mul(acc, x), c);
+    }
+    return acc;
+}
+
+static void iso_map(const Fp2& x, const Fp2& y, Fp2* ox, Fp2* oy) {
+    Fp2 xn = horner(ISO_X_NUM, ISO_X_NUM_N, x);
+    Fp2 xd = horner(ISO_X_DEN, ISO_X_DEN_N, x);
+    Fp2 yn = horner(ISO_Y_NUM, ISO_Y_NUM_N, x);
+    Fp2 yd = horner(ISO_Y_DEN, ISO_Y_DEN_N, x);
+    *ox = f2mul(xn, f2inv(xd));
+    *oy = f2mul(y, f2mul(yn, f2inv(yd)));
+}
+
+static G2Aff hash_to_g2(const uint8_t* msg, size_t mlen) {
+    uint8_t pseudo[256];
+    expand_xmd(msg, mlen, pseudo, 256);
+    Fp2 u0 = { fp_from_be64(pseudo), fp_from_be64(pseudo + 64) };
+    Fp2 u1 = { fp_from_be64(pseudo + 128), fp_from_be64(pseudo + 192) };
+    Fp2 x0, y0, x1, y1;
+    sswu(u0, &x0, &y0); iso_map(x0, y0, &x0, &y0);
+    sswu(u1, &x1, &y1); iso_map(x1, y1, &x1, &y1);
+    Jac<Fp2> q = { x0, y0, f2_one() };
+    q = jac_add_affine<Fp2Ops>(q, x1, y1);
+    // cofactor clearing by h_eff: need affine base for jac_mul
+    Fp2 bx, by;
+    G2Aff out;
+    if (!jac_to_affine<Fp2Ops>(q, &bx, &by)) { out.inf = true; return out; }
+    Jac<Fp2> r = jac_mul<Fp2Ops>(bx, by, HEFF, HEFF_NLIMBS);
+    out.inf = !jac_to_affine<Fp2Ops>(r, &out.x, &out.y);
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// Serialization (spec: g1g2.py ZCash format)
+// ---------------------------------------------------------------------------
+
+static void fp_to_be48(const Fp& a, uint8_t out[48]) {
+    uint64_t raw[6];
+    FP.from_mont(raw, a.l);
+    for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++)
+            out[8*i + j] = (uint8_t)(raw[5-i] >> (56 - 8*j));
+}
+
+static bool fp_from_be48(const uint8_t in[48], Fp* out) {
+    uint64_t raw[6];
+    for (int i = 0; i < 6; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = v << 8 | in[8*i + j];
+        raw[5-i] = v;
+    }
+    if (FP.geq_mod(raw)) return false;
+    FP.to_mont(out->l, raw);
+    return true;
+}
+
+struct G1Aff { Fp x, y; bool inf; };
+
+static bool fp_sqrt(const Fp& a, Fp* out) {
+    Fp c; FP.pow(c.l, a.l, FP_P14, 6);
+    if (!feq(fsqr(c), a)) return false;
+    *out = c;
+    return true;
+}
+
+static Fp g1_b() { uint64_t raw[6] = {4,0,0,0,0,0}; Fp b; FP.to_mont(b.l, raw); return b; }
+static Fp2 g2_b() { Fp2 b; memcpy(&b, CURVE_B2, sizeof(b)); return b; }
+
+static bool g1_from_bytes(const uint8_t in[48], G1Aff* out, bool subgroup_check) {
+    uint8_t flags = in[0];
+    if (!(flags & 0x80)) return false;
+    if (flags & 0x40) {
+        for (int i = 1; i < 48; i++) if (in[i]) return false;
+        if (flags & 0x20 || (in[0] & 0x3f)) return false;
+        out->inf = true;
+        return true;
+    }
+    uint8_t tmp[48];
+    memcpy(tmp, in, 48);
+    tmp[0] &= 0x1f;
+    Fp x;
+    if (!fp_from_be48(tmp, &x)) return false;
+    Fp rhs = fadd(fmul(fsqr(x), x), g1_b());
+    Fp y;
+    if (!fp_sqrt(rhs, &y)) return false;
+    if (fp_is_lex_largest(y) != !!(flags & 0x20)) y = fneg(y);
+    out->x = x; out->y = y; out->inf = false;
+    if (subgroup_check) {
+        Jac<Fp> r = jac_mul<FpOps>(x, y, GROUP_ORDER, 4);
+        if (!FpOps::zero(r.z)) return false;
+    }
+    return true;
+}
+
+static void g1_to_bytes(const G1Aff& p, uint8_t out[48]) {
+    if (p.inf) { memset(out, 0, 48); out[0] = 0xc0; return; }
+    fp_to_be48(p.x, out);
+    out[0] |= 0x80;
+    if (fp_is_lex_largest(p.y)) out[0] |= 0x20;
+}
+
+static bool g2_from_bytes(const uint8_t in[96], G2Aff* out, bool subgroup_check) {
+    uint8_t flags = in[0];
+    if (!(flags & 0x80)) return false;
+    if (flags & 0x40) {
+        for (int i = 1; i < 96; i++) if (in[i]) return false;
+        if (flags & 0x20 || (in[0] & 0x3f)) return false;
+        out->inf = true;
+        return true;
+    }
+    uint8_t tmp[48];
+    memcpy(tmp, in, 48);
+    tmp[0] &= 0x1f;
+    Fp x1, x0;
+    if (!fp_from_be48(tmp, &x1)) return false;
+    if (!fp_from_be48(in + 48, &x0)) return false;
+    Fp2 x = { x0, x1 };
+    Fp2 rhs = f2add(f2mul(f2sqr(x), x), g2_b());
+    Fp2 y;
+    if (!f2sqrt(rhs, &y)) return false;
+    if (f2_is_lex_largest(y) != !!(flags & 0x20)) y = f2neg(y);
+    out->x = x; out->y = y; out->inf = false;
+    if (subgroup_check) {
+        Jac<Fp2> r = jac_mul<Fp2Ops>(x, y, GROUP_ORDER, 4);
+        if (!Fp2Ops::zero(r.z)) return false;
+    }
+    return true;
+}
+
+static void g2_to_bytes(const G2Aff& p, uint8_t out[96]) {
+    if (p.inf) { memset(out, 0, 96); out[0] = 0xc0; return; }
+    fp_to_be48(p.x.c1, out);
+    fp_to_be48(p.x.c0, out + 48);
+    out[0] |= 0x80;
+    if (f2_is_lex_largest(p.y)) out[0] |= 0x20;
+}
+
+// ---------------------------------------------------------------------------
+// Fr helpers (Lagrange; spec: shamir.py)
+// ---------------------------------------------------------------------------
+
+struct Fr4 { uint64_t l[4]; };
+static Fr4 fr_from_u64(uint64_t v) { uint64_t raw[4] = { v, 0, 0, 0 }; Fr4 o; FR.to_mont(o.l, raw); return o; }
+static Fr4 fr_mulv(const Fr4& a, const Fr4& b) { Fr4 o; FR.mul(o.l, a.l, b.l); return o; }
+static Fr4 fr_subv(const Fr4& a, const Fr4& b) { Fr4 o; FR.sub(o.l, a.l, b.l); return o; }
+static Fr4 fr_invv(const Fr4& a) { Fr4 o; FR.pow(o.l, a.l, FR_RM2, 4); return o; }
+
+// ---------------------------------------------------------------------------
+// Public API
+// ---------------------------------------------------------------------------
+
+static bool INITED = false;
+static void ensure_init() {
+    if (!INITED) { init_frobenius(); INITED = true; }
+}
+
+extern "C" {
+
+// returns 1 on success (valid signature), 0 on failure
+int ctpu_verify(const uint8_t pk[48], const uint8_t* msg, size_t mlen, const uint8_t sig[96]) {
+    ensure_init();
+    G1Aff p; G2Aff s;
+    if (!g1_from_bytes(pk, &p, true) || p.inf) return 0;
+    if (!g2_from_bytes(sig, &s, true) || s.inf) return 0;
+    G2Aff h = hash_to_g2(msg, mlen);
+    if (h.inf) return 0;
+    // e(pk, H(m)) * e(-G1, sig) == 1
+    Fp px[2], py[2]; Fp2 qx[2], qy[2]; bool dead[2] = { false, false };
+    px[0] = p.x; py[0] = p.y; qx[0] = h.x; qy[0] = h.y;
+    memcpy(px[1].l, G1X, 48);
+    Fp gy; memcpy(gy.l, G1Y, 48);
+    py[1] = fneg(gy);
+    qx[1] = s.x; qy[1] = s.y;
+    Fp12 f = miller_loop(2, px, py, qx, qy, dead);
+    return f12_is_one(final_exp(f)) ? 1 : 0;
+}
+
+int ctpu_sign(const uint8_t sk[32], const uint8_t* msg, size_t mlen, uint8_t out[96]) {
+    ensure_init();
+    uint64_t k[4];
+    for (int i = 0; i < 4; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = v << 8 | sk[8*i + j];
+        k[3-i] = v;
+    }
+    G2Aff h = hash_to_g2(msg, mlen);
+    if (h.inf) return 0;
+    Jac<Fp2> r = jac_mul<Fp2Ops>(h.x, h.y, k, 4);
+    G2Aff o;
+    o.inf = !jac_to_affine<Fp2Ops>(r, &o.x, &o.y);
+    g2_to_bytes(o, out);
+    return 1;
+}
+
+int ctpu_sk_to_pk(const uint8_t sk[32], uint8_t out[48]) {
+    ensure_init();
+    uint64_t k[4];
+    for (int i = 0; i < 4; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = v << 8 | sk[8*i + j];
+        k[3-i] = v;
+    }
+    Fp gx, gy; memcpy(gx.l, G1X, 48); memcpy(gy.l, G1Y, 48);
+    Jac<Fp> r = jac_mul<FpOps>(gx, gy, k, 4);
+    G1Aff o;
+    o.inf = !jac_to_affine<FpOps>(r, &o.x, &o.y);
+    g1_to_bytes(o, out);
+    return 1;
+}
+
+// aggregate n signatures (G2 point addition)
+int ctpu_aggregate(int n, const uint8_t* sigs, uint8_t out[96]) {
+    ensure_init();
+    Jac<Fp2> acc = { f2_zero(), f2_zero(), f2_zero() };
+    for (int i = 0; i < n; i++) {
+        G2Aff s;
+        if (!g2_from_bytes(sigs + 96*i, &s, true)) return 0;
+        if (s.inf) continue;
+        acc = jac_add_affine<Fp2Ops>(acc, s.x, s.y);
+    }
+    G2Aff o;
+    o.inf = !jac_to_affine<Fp2Ops>(acc, &o.x, &o.y);
+    g2_to_bytes(o, out);
+    return 1;
+}
+
+int ctpu_aggregate_pks(int n, const uint8_t* pks, uint8_t out[48]) {
+    ensure_init();
+    Jac<Fp> acc = { FP_ZERO_V, FP_ZERO_V, FP_ZERO_V };
+    for (int i = 0; i < n; i++) {
+        G1Aff p;
+        if (!g1_from_bytes(pks + 48*i, &p, true) || p.inf) return 0;
+        acc = jac_add_affine<FpOps>(acc, p.x, p.y);
+    }
+    G1Aff o;
+    o.inf = !jac_to_affine<FpOps>(acc, &o.x, &o.y);
+    g1_to_bytes(o, out);
+    return 1;
+}
+
+// threshold aggregate: indices are 1-based share ids
+int ctpu_threshold_aggregate(int n, const uint64_t* indices, const uint8_t* sigs, uint8_t out[96]) {
+    ensure_init();
+    Jac<Fp2> acc = { f2_zero(), f2_zero(), f2_zero() };
+    for (int i = 0; i < n; i++) {
+        // lambda_i = prod_{j!=i} x_j / (x_j - x_i) mod r
+        Fr4 num = fr_from_u64(1), den = fr_from_u64(1);
+        Fr4 xi = fr_from_u64(indices[i]);
+        for (int j = 0; j < n; j++) {
+            if (j == i) continue;
+            Fr4 xj = fr_from_u64(indices[j]);
+            num = fr_mulv(num, xj);
+            den = fr_mulv(den, fr_subv(xj, xi));
+        }
+        Fr4 lam = fr_mulv(num, fr_invv(den));
+        uint64_t raw[4];
+        FR.from_mont(raw, lam.l);
+        G2Aff s;
+        if (!g2_from_bytes(sigs + 96*i, &s, true) || s.inf) return 0;
+        Jac<Fp2> term = jac_mul<Fp2Ops>(s.x, s.y, raw, 4);
+        Fp2 tx, ty;
+        if (jac_to_affine<Fp2Ops>(term, &tx, &ty))
+            acc = jac_add_affine<Fp2Ops>(acc, tx, ty);
+    }
+    G2Aff o;
+    o.inf = !jac_to_affine<Fp2Ops>(acc, &o.x, &o.y);
+    g2_to_bytes(o, out);
+    return 1;
+}
+
+// batch verify: results[i] = 1/0. msgs given as concatenated buffer+offsets.
+int ctpu_verify_batch(int n, const uint8_t* pks, const uint8_t* msgs,
+                      const uint64_t* msg_offsets, const uint8_t* sigs,
+                      uint8_t* results) {
+    ensure_init();
+    #pragma omp parallel for schedule(dynamic)
+    for (int i = 0; i < n; i++) {
+        results[i] = (uint8_t)ctpu_verify(
+            pks + 48*i,
+            msgs + msg_offsets[i],
+            (size_t)(msg_offsets[i+1] - msg_offsets[i]),
+            sigs + 96*i);
+    }
+    return 1;
+}
+
+int ctpu_hash_to_g2(const uint8_t* msg, size_t mlen, uint8_t out[96]) {
+    ensure_init();
+    G2Aff h = hash_to_g2(msg, mlen);
+    g2_to_bytes(h, out);
+    return 1;
+}
+
+}  // extern "C"

@@ -1,0 +1,91 @@
+"""Native C++ backend vs the Python specification (cross-impl suite, same
+role as the reference's randomized cross-backend tests for herumi —
+ref: tbls/tbls_test.go:209)."""
+
+import pytest
+
+pytest.importorskip("charon_tpu.tbls.native_impl")
+
+from charon_tpu.crypto import h2c
+from charon_tpu.crypto.g1g2 import g2_to_bytes
+from charon_tpu.tbls import TblsError
+from charon_tpu.tbls.native_impl import NativeImpl
+from charon_tpu.tbls.python_impl import PythonImpl
+
+MSG = b"native cross-impl message"
+
+
+@pytest.fixture(scope="module")
+def impls():
+    return PythonImpl(), NativeImpl()
+
+
+@pytest.fixture(scope="module")
+def keys(impls):
+    py, _ = impls
+    sk = py.generate_secret_key()
+    return sk, py.secret_to_public_key(sk)
+
+
+def test_sign_verify_cross(impls, keys):
+    py, nat = impls
+    sk, pk = keys
+    assert nat.secret_to_public_key(sk) == pk
+    sig_nat = nat.sign(sk, MSG)
+    assert sig_nat == py.sign(sk, MSG)  # byte-identical signatures
+    nat.verify(pk, MSG, sig_nat)
+    py.verify(pk, MSG, sig_nat)
+    with pytest.raises(TblsError):
+        nat.verify(pk, b"tampered", sig_nat)
+    with pytest.raises(TblsError):
+        nat.verify(pk, MSG, sig_nat[:-1] + bytes([sig_nat[-1] ^ 1]))
+
+
+def test_hash_to_g2_matches_spec(impls):
+    _, nat = impls
+    for msg in (b"", b"abc", b"a" * 200):
+        want = g2_to_bytes(h2c.hash_to_g2(msg))
+        assert nat.hash_to_g2_bytes(msg) == want
+
+
+def test_threshold_cycle_cross(impls, keys):
+    py, nat = impls
+    sk, pk = keys
+    shares = py.threshold_split(sk, 5, 3)
+    partials = {i: nat.sign(s, MSG) for i, s in shares.items()}
+    for sub_idx in ((1, 2, 3), (2, 4, 5), (1, 3, 5)):
+        sub = {i: partials[i] for i in sub_idx}
+        agg_nat = nat.threshold_aggregate(sub)
+        assert agg_nat == py.threshold_aggregate(sub)
+        nat.verify(pk, MSG, agg_nat)
+
+
+def test_aggregate_and_verify_aggregate_cross(impls):
+    py, nat = impls
+    sks = [py.generate_secret_key() for _ in range(3)]
+    pks = [py.secret_to_public_key(s) for s in sks]
+    sigs = [nat.sign(s, MSG) for s in sks]
+    agg = nat.aggregate(sigs)
+    assert agg == py.aggregate(sigs)
+    nat.verify_aggregate(pks, MSG, agg)
+    with pytest.raises(TblsError):
+        nat.verify_aggregate(pks[:2], MSG, agg)
+
+
+def test_native_verify_batch(impls, keys):
+    py, nat = impls
+    sk, pk = keys
+    good = nat.sign(sk, MSG)
+    bad = nat.sign(sk, b"other")
+    out = nat.verify_batch(
+        [(pk, MSG, good), (pk, MSG, bad), (pk, MSG, good), (pk, b"x", good)]
+    )
+    assert out == [True, False, True, False]
+
+
+def test_native_rejects_malformed(impls):
+    _, nat = impls
+    with pytest.raises(TblsError):
+        nat.verify(bytes(48), MSG, bytes(96))
+    with pytest.raises(TblsError):
+        nat.threshold_aggregate({0: bytes(96)})
