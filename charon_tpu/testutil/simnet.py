@@ -8,6 +8,7 @@ completion via the broadcast recorder.
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass, field
 
 from charon_tpu import tbls
@@ -161,6 +162,19 @@ def _build_node(
         slots_per_epoch=spe,
     )
 
+    # fetcher.fetch must run as its own task: proposer fetches block on the
+    # aggregated randao, which only exists after the VC submits its randao
+    # partial — the reference decouples this with async retry
+    # (ref: app/retry wired via core.WithAsyncRetry, app/app.go:571).
+    def spawn_fetch(name, fn):
+        if name != "fetcher.fetch":
+            return fn
+
+        async def wrapped(duty, defs):
+            asyncio.create_task(fn(duty, defs))
+
+        return wrapped
+
     wire(
         scheduler=scheduler,
         fetcher=fetcher,
@@ -172,7 +186,10 @@ def _build_node(
         sigagg=sigagg,
         aggsigdb=aggsigdb,
         broadcaster=bcast,
+        options=[spawn_fetch],
     )
+    # fetcher pulls the aggregated randao from aggsigdb
+    fetcher.register_agg_sig_db(aggsigdb.await_)
 
     vmock = ValidatorMock(
         vapi=vapi,
@@ -189,7 +206,10 @@ def _build_node(
         if duty.type == DutyType.ATTESTER:
             await vmock.attest(duty.slot, defs)
         elif duty.type == DutyType.PROPOSER:
-            ...  # proposer flow wired in the proposal simnet test
+            # run concurrently: proposal request blocks until consensus,
+            # which needs this very VC's randao partial first
+            for pubkey in defs:
+                asyncio.create_task(vmock.propose(duty.slot, pubkey))
 
     scheduler.subscribe_duties(on_duty)
 

@@ -18,9 +18,17 @@ from charon_tpu.testutil.simnet import build_cluster
 
 
 @pytest.fixture(autouse=True)
-def python_tbls():
-    tbls.set_implementation(PythonImpl())
+def host_tbls():
+    # Prefer the native C++ backend (bit-compatible, ~20x faster) so the
+    # simnet exercises realistic crypto latencies; fall back to Python.
+    try:
+        from charon_tpu.tbls.native_impl import NativeImpl
+
+        tbls.set_implementation(NativeImpl())
+    except ImportError:
+        tbls.set_implementation(PythonImpl())
     yield
+    tbls.set_implementation(PythonImpl())
 
 
 async def _drive_and_check(cluster):
@@ -31,7 +39,7 @@ async def _drive_and_check(cluster):
     try:
 
         async def all_done():
-            while len(beacon.attestations) < 4:
+            while len(beacon.attestations) < 4 or len(beacon.proposals) < 4:
                 await asyncio.sleep(0.05)
 
         await asyncio.wait_for(all_done(), timeout=60)
@@ -51,6 +59,16 @@ async def _drive_and_check(cluster):
         cluster.fork, att.data.slot // beacon.slots_per_epoch
     )
     tbls.verify(pubkey_to_bytes(group_pk), root, att.signature)
+
+    # proposer flow: all nodes broadcast the same valid signed block
+    props = beacon.proposals[:4]
+    psigs = {sig for _, sig in props}
+    assert len(psigs) == 1
+    proposal, psig = props[0]
+    proot = SignedData("block", proposal).signing_root(
+        cluster.fork, proposal.header.slot // beacon.slots_per_epoch
+    )
+    tbls.verify(pubkey_to_bytes(group_pk), proot, psig)
 
 
 def test_simnet_attestation_flow():
