@@ -1,0 +1,136 @@
+"""Relay server + client: rendezvous and frame forwarding for NAT'd peers.
+
+Mirrors ref: p2p/relay.go + cmd/relay — the reference uses libp2p
+circuit-relay-v2 with reservations refreshed continuously and relay-HTTP
+peer discovery (discv5 was removed). Here: an asyncio TCP relay that
+registered peers keep a connection to; frames addressed to a peer index
+are forwarded over its registered connection. Peers prefer direct dials
+and fall back to the relay (ref: ForceDirectConnections upgrades relayed
+connections, app/app.go:352-353).
+
+Wire format between peer and relay:
+  register:  {"op": "register", "cluster": hex, "idx": n}
+  send:      {"op": "send", "to": n} + payload frame follows
+  deliver:   {"op": "deliver", "from": n} + payload frame follows
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from collections import defaultdict
+
+from charon_tpu.p2p.transport import MAX_FRAME, _read_frame, _write_frame
+
+
+class RelayServer:
+    """`charon-tpu relay` (ref: cmd/relay/relay.go:46)."""
+
+    def __init__(self) -> None:
+        self._server: asyncio.AbstractServer | None = None
+        # (cluster, idx) -> writer
+        self._peers: dict[tuple[str, int], asyncio.StreamWriter] = {}
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        for w in self._peers.values():
+            w.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer) -> None:
+        key = None
+        try:
+            hello = json.loads(await _read_frame(reader))
+            if hello.get("op") != "register":
+                writer.close()
+                return
+            key = (hello["cluster"], int(hello["idx"]))
+            self._peers[key] = writer
+            while True:
+                header = json.loads(await _read_frame(reader))
+                payload = await _read_frame(reader)
+                if header.get("op") != "send":
+                    continue
+                target = self._peers.get((key[0], int(header["to"])))
+                if target is None or target.is_closing():
+                    continue
+                _write_frame(
+                    target,
+                    json.dumps({"op": "deliver", "from": key[1]}).encode(),
+                )
+                _write_frame(target, payload)
+                await target.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, json.JSONDecodeError):
+            pass
+        finally:
+            if key is not None and self._peers.get(key) is writer:
+                del self._peers[key]
+            writer.close()
+
+
+class RelayClient:
+    """Keeps a registered connection to the relay and exposes
+    send/receive of raw frames (the P2PNode can route through this when a
+    direct dial fails — relay fallback)."""
+
+    def __init__(self, host: str, port: int, cluster_hash: bytes, index: int) -> None:
+        self.host = host
+        self.port = port
+        self.cluster = cluster_hash.hex()
+        self.index = index
+        self._reader = None
+        self._writer = None
+        self._handlers = []
+        self._recv_task: asyncio.Task | None = None
+
+    def on_frame(self, handler) -> None:
+        """handler(from_idx: int, payload: bytes)"""
+        self._handlers.append(handler)
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        _write_frame(
+            self._writer,
+            json.dumps(
+                {"op": "register", "cluster": self.cluster, "idx": self.index}
+            ).encode(),
+        )
+        await self._writer.drain()
+        self._recv_task = asyncio.create_task(self._recv_loop())
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                header = json.loads(await _read_frame(self._reader))
+                payload = await _read_frame(self._reader)
+                if header.get("op") != "deliver":
+                    continue
+                for h in self._handlers:
+                    res = h(int(header["from"]), payload)
+                    if asyncio.iscoroutine(res):
+                        await res
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+
+    async def send(self, to_idx: int, payload: bytes) -> None:
+        _write_frame(
+            self._writer,
+            json.dumps({"op": "send", "to": to_idx}).encode(),
+        )
+        _write_frame(self._writer, payload)
+        await self._writer.drain()
+
+    async def close(self) -> None:
+        if self._recv_task:
+            self._recv_task.cancel()
+        if self._writer:
+            self._writer.close()
