@@ -22,6 +22,7 @@ class Broadcaster:
     def __post_init__(self) -> None:
         self.broadcast_total: dict[DutyType, int] = {}
         self.broadcast_delay: list[tuple[Duty, float]] = []
+        self._registrations: dict[Duty, dict] = {}
 
     async def broadcast(self, duty: Duty, data_set: dict[PubKey, SignedData]) -> None:
         """ref: core/bcast/bcast.go:42 Broadcast type-switch."""
@@ -34,8 +35,26 @@ class Broadcaster:
                 pass  # randao is an input to proposals, never broadcast
             elif duty.type == DutyType.BUILDER_REGISTRATION:
                 await self.beacon.submit_registration(signed.payload, signed.signature)
+                self._registrations[duty] = data_set  # for the recaster
             elif duty.type == DutyType.EXIT:
                 await self.beacon.submit_exit(signed.payload, signed.signature)
+            elif duty.type == DutyType.AGGREGATOR:
+                await self.beacon.submit_aggregate(signed.payload, signed.signature)
+            elif duty.type == DutyType.SYNC_MESSAGE:
+                from dataclasses import replace as _replace
+
+                await self.beacon.submit_sync_message(
+                    _replace(signed.payload, signature=signed.signature)
+                    if hasattr(signed.payload, "signature")
+                    else signed.payload
+                )
+            elif duty.type == DutyType.SYNC_CONTRIBUTION:
+                await self.beacon.submit_contribution(signed.payload, signed.signature)
+            elif duty.type in (
+                DutyType.PREPARE_AGGREGATOR,
+                DutyType.PREPARE_SYNC_CONTRIBUTION,
+            ):
+                pass  # selection proofs are inputs to later duties
             else:
                 raise ValueError(f"cannot broadcast duty type {duty.type}")
         self.broadcast_total[duty.type] = (
@@ -51,3 +70,15 @@ class Broadcaster:
         from dataclasses import replace
 
         return replace(signed.payload, signature=signed.signature)
+
+    async def recast(self, slot) -> None:
+        """Re-broadcast validator registrations every epoch
+        (ref: core/bcast/recast.go Recaster; wiring app/app.go:677-743).
+        Subscribe to scheduler slots."""
+        if slot.slot % slot.slots_per_epoch != 0:
+            return
+        for duty, data_set in list(self._registrations.items()):
+            for pubkey, signed in data_set.items():
+                await self.beacon.submit_registration(
+                    signed.payload, signed.signature
+                )

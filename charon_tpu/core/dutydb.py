@@ -60,6 +60,7 @@ class DutyDB:
         self._proposal = _AwaitMap()  # (slot, pubkey) -> Proposal
         self._agg_att = _AwaitMap()  # (slot, att_data_root) -> Attestation
         self._contrib = _AwaitMap()  # (slot, subcommittee, root) -> Contribution
+        self._sync_msg = _AwaitMap()  # (slot, pubkey) -> SyncMessageDuty
         self._att_by_root: dict[tuple[int, bytes], PubKey] = {}
         self._unique: dict[tuple, bytes] = {}
 
@@ -78,8 +79,12 @@ class DutyDB:
                 assert isinstance(unsigned, Proposal)
                 self._proposal.resolve((duty.slot, pubkey), unsigned)
             elif duty.type == DutyType.AGGREGATOR:
-                root = unsigned.data.hash_tree_root()
+                # unsigned is an AggregateAndProof; key by the aggregated
+                # attestation's data root (ref: memory.go agg att keying)
+                root = unsigned.aggregate.data.hash_tree_root()
                 self._agg_att.resolve((duty.slot, root), unsigned)
+            elif duty.type == DutyType.SYNC_MESSAGE:
+                self._sync_msg.resolve((duty.slot, pubkey), unsigned)
             elif duty.type == DutyType.SYNC_CONTRIBUTION:
                 key = (
                     duty.slot,
@@ -109,6 +114,9 @@ class DutyDB:
     async def await_aggregated_attestation(self, slot: int, att_data_root: bytes):
         return await self._agg_att.await_((slot, att_data_root))
 
+    async def await_sync_message(self, slot: int, pubkey: PubKey):
+        return await self._sync_msg.await_((slot, pubkey))
+
     async def await_sync_contribution(
         self, slot: int, subcommittee_index: int, beacon_block_root: bytes
     ):
@@ -126,6 +134,7 @@ class DutyDB:
     def trim(self, expired: Duty) -> None:
         slot = expired.slot
         self._att.trim(lambda k: k[0] != slot)
+        self._sync_msg.trim(lambda k: k[0] != slot)
         self._proposal.trim(lambda k: k[0] != slot)
         self._agg_att.trim(lambda k: k[0] != slot)
         self._contrib.trim(lambda k: k[0] != slot)

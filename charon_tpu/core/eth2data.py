@@ -204,6 +204,17 @@ class VoluntaryExit:
 
 
 @dataclass(frozen=True)
+class SyncMessageDuty:
+    """Consensus payload for a sync-committee message: the agreed head
+    block root every member signs."""
+
+    beacon_block_root: bytes
+
+    def hash_tree_root(self) -> bytes:
+        return self.beacon_block_root
+
+
+@dataclass(frozen=True)
 class AttestationDuty:
     """Consensus payload for an attester duty: the agreed attestation data
     plus the validator's committee coordinates (the reference keeps these

@@ -42,6 +42,12 @@ class Fetcher:
             unsigned = await self._fetch_attester(duty, defs)
         elif duty.type == DutyType.PROPOSER:
             unsigned = await self._fetch_proposer(duty, defs)
+        elif duty.type == DutyType.AGGREGATOR:
+            unsigned = await self._fetch_aggregator(duty, defs)
+        elif duty.type == DutyType.SYNC_MESSAGE:
+            unsigned = await self._fetch_sync_message(duty, defs)
+        elif duty.type == DutyType.SYNC_CONTRIBUTION:
+            unsigned = await self._fetch_sync_contribution(duty, defs)
         else:
             raise ValueError(f"unsupported fetch duty type {duty.type}")
         if unsigned:
@@ -63,6 +69,48 @@ class Fetcher:
                 committee_length=d.committee_length,
                 committee_index=d.committee_index,
                 validator_committee_index=d.validator_committee_index,
+            )
+        return out
+
+    async def _fetch_aggregator(self, duty, defs):
+        """Aggregate attestations: needs the attestation data root from
+        DutyDB plus the aggregated selection proof from AggSigDB
+        (ref: core/fetcher/fetcher.go:158 aggregate flow)."""
+        from charon_tpu.core.eth2data import AggregateAndProof
+
+        out = {}
+        for pubkey, d in defs.items():
+            # the aggregated selection proof gates aggregation and is
+            # embedded in the unsigned AggregateAndProof the VC signs
+            # (ref: fetcher.go:158 + eth2exp selections).
+            sel = await self._await_agg_sig(
+                Duty(duty.slot, DutyType.PREPARE_AGGREGATOR), pubkey
+            )
+            att_duty = await self._await_attestation(duty.slot, pubkey)
+            root = att_duty.data.hash_tree_root()
+            agg_att = await self.beacon.aggregate_attestation(duty.slot, root)
+            out[pubkey] = AggregateAndProof(
+                aggregator_index=d.validator_index,
+                aggregate=agg_att,
+                selection_proof=sel.signature,
+            )
+        return out
+
+    async def _fetch_sync_message(self, duty, defs):
+        from charon_tpu.core.eth2data import SyncMessageDuty
+
+        root = await self.beacon.sync_committee_block_root(duty.slot)
+        return {pk: SyncMessageDuty(beacon_block_root=root) for pk in defs}
+
+    async def _fetch_sync_contribution(self, duty, defs):
+        out = {}
+        for pubkey, d in defs.items():
+            await self._await_agg_sig(
+                Duty(duty.slot, DutyType.PREPARE_SYNC_CONTRIBUTION), pubkey
+            )
+            root = await self.beacon.sync_committee_block_root(duty.slot)
+            out[pubkey] = await self.beacon.sync_contribution(
+                duty.slot, d.committee_index, root
             )
         return out
 

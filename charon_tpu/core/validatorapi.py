@@ -49,6 +49,7 @@ class ValidatorAPI:
         self._await_proposal = None
         self._await_agg_att = None
         self._await_contrib = None
+        self._await_sync_msg = None
         self._pubkey_by_att = None
         self._duty_defs = None
 
@@ -68,6 +69,9 @@ class ValidatorAPI:
 
     def register_await_sync_contribution(self, fn) -> None:
         self._await_contrib = fn
+
+    def register_await_sync_message(self, fn) -> None:
+        self._await_sync_msg = fn
 
     def register_pubkey_by_attestation(self, fn) -> None:
         self._pubkey_by_att = fn
@@ -131,6 +135,55 @@ class ValidatorAPI:
         signed = SignedData("randao", epoch, signature)
         self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.RANDAO)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def submit_selection_proof(self, slot: int, pubkey: PubKey, signature: bytes) -> None:
+        """Beacon-committee selection partials
+        (ref: validatorapi.go:724 AggregateBeaconCommitteeSelections)."""
+        signed = SignedData("selection_proof", slot, signature)
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.PREPARE_AGGREGATOR)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def aggregate_attestation(self, slot: int, att_data_root: bytes):
+        """Blocking fetch of the cluster-agreed aggregate."""
+        return await self._await_agg_att(slot, att_data_root)
+
+    async def submit_aggregate_and_proof(self, pubkey: PubKey, agg, signature: bytes) -> None:
+        signed = SignedData("aggregate_and_proof", agg, signature)
+        self._check_batch(
+            [self._verify_item(pubkey, signed, agg.aggregate.data.slot)]
+        )
+        duty = Duty(agg.aggregate.data.slot, DutyType.AGGREGATOR)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def sync_message_duty(self, slot: int, pubkey: PubKey):
+        return await self._await_sync_msg(slot, pubkey)
+
+    async def submit_sync_message(self, slot: int, pubkey: PubKey, msg, signature: bytes) -> None:
+        signed = SignedData("sync_message", msg, signature)
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.SYNC_MESSAGE)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def submit_exit(self, pubkey: PubKey, exit_msg, signature: bytes) -> None:
+        """Voluntary exit partial (ref: exit flow, validatorapi exit
+        endpoints + cmd/exit_sign.go)."""
+        signed = SignedData("exit", exit_msg, signature)
+        slot = exit_msg.epoch * self.slots_per_epoch
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.EXIT)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def submit_registration(self, pubkey: PubKey, reg, signature: bytes, slot: int = 0) -> None:
+        signed = SignedData("registration", reg, signature)
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.BUILDER_REGISTRATION)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
 

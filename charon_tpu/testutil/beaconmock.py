@@ -40,6 +40,9 @@ class BeaconMock:
         self.proposals: list = []
         self.registrations: list = []
         self.exits: list = []
+        self.aggregates: list = []
+        self.sync_messages: list = []
+        self.contributions: list = []
         # test override hooks (ref: beaconmock/options.go pattern)
         self.attestation_data_fn = self._attestation_data_default
 
@@ -120,10 +123,42 @@ class BeaconMock:
             body=body,
         )
 
+    async def aggregate_attestation(self, slot: int, att_data_root: bytes):
+        """Aggregate attestation for an att data root (the BN would merge
+        pool attestations; deterministic here)."""
+        from charon_tpu.core.eth2data import Attestation
+
+        data = self.attestation_data_fn(slot, 0)
+        return Attestation(
+            aggregation_bits=(True, True), data=data
+        )
+
+    async def sync_committee_block_root(self, slot: int) -> bytes:
+        return self._root("block", slot)
+
+    async def sync_contribution(self, slot: int, subcommittee_index: int, block_root: bytes):
+        from charon_tpu.core.eth2data import SyncCommitteeContribution
+
+        return SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=block_root,
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=tuple(i < 2 for i in range(128)),
+        )
+
     # -- submissions ------------------------------------------------------
 
     async def submit_attestation(self, att) -> None:
         self.attestations.append(att)
+
+    async def submit_aggregate(self, agg_and_proof, signature: bytes) -> None:
+        self.aggregates.append((agg_and_proof, signature))
+
+    async def submit_sync_message(self, msg) -> None:
+        self.sync_messages.append(msg)
+
+    async def submit_contribution(self, contrib_and_proof, signature: bytes) -> None:
+        self.contributions.append((contrib_and_proof, signature))
 
     async def submit_proposal(self, proposal, signature: bytes) -> None:
         self.proposals.append((proposal, signature))
