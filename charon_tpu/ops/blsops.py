@@ -31,7 +31,9 @@ from charon_tpu.ops.limb import ModCtx
 
 
 def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1)).bit_length()
+    """Padded batch size: next power of two, minimum 4 — so every small
+    call shares one compiled program (kernel-shape discipline)."""
+    return max(4, 1 << max(0, (n - 1)).bit_length())
 
 
 # ---------------------------------------------------------------------------
