@@ -73,3 +73,13 @@ def verify_bytes(pubkey_bytes: bytes, digest: bytes, sig: bytes) -> bool:
         return verify(public_key_from_bytes(pubkey_bytes), digest, sig)
     except Exception:
         return False
+
+
+def ecdh(key: ec.EllipticCurvePrivateKey, peer_pubkey_bytes: bytes) -> bytes:
+    """Static-static ECDH shared secret with a peer's compressed pubkey.
+
+    Used to derive per-connection MAC keys in the p2p handshake (the
+    reference gets the same property from libp2p-TLS with pinned peer
+    identities, ref: p2p/p2p.go security transport)."""
+    peer = public_key_from_bytes(peer_pubkey_bytes)
+    return key.exchange(ec.ECDH(), peer)

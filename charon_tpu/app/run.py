@@ -28,7 +28,7 @@ from charon_tpu.core.deadline import Deadliner, SlotClock
 from charon_tpu.core.dutydb import DutyDB
 from charon_tpu.core.fetcher import Fetcher
 from charon_tpu.core.parsigdb import ParSigDB
-from charon_tpu.core.parsigex import Eth2Verifier, ParSigEx
+from charon_tpu.core.parsigex import DutyGater, Eth2Verifier, ParSigEx
 from charon_tpu.core.scheduler import Scheduler
 from charon_tpu.core.sigagg import SigAgg
 from charon_tpu.core.tracker import Tracker, tracking
@@ -180,9 +180,18 @@ async def build_node(config: Config) -> Node:
     aggsigdb = AggSigDB()
     bcast = Broadcaster(beacon=beacon, clock=clock)
     fetcher = Fetcher(beacon)
-    consensus = ConsensusController(
-        QBFTConsensus(qbft_net, n)
+    # Per-message k1 auth: every consensus message (and each piggybacked
+    # justification) is signed/verified against the operators' keys
+    # (ref: core/consensus/qbft/transport.go:25-50, qbft.go:561).
+    op_pubkeys = [
+        bytes.fromhex(op.enr.split(":")[-1])
+        for op in lock.definition.operators
+    ]
+    duty_gater = DutyGater(clock, slots_per_epoch=config.slots_per_epoch)
+    qbft_consensus = QBFTConsensus(
+        qbft_net, n, privkey=k1_key, pubkeys=op_pubkeys, gater=duty_gater
     )
+    consensus = ConsensusController(qbft_consensus)
     vapi = ValidatorAPI(
         share_idx=share_idx,
         pubshares=pubshares_by_idx[share_idx],
@@ -190,7 +199,9 @@ async def build_node(config: Config) -> Node:
         slots_per_epoch=config.slots_per_epoch,
     )
     verifier = Eth2Verifier(fork, pubshares_by_idx, config.slots_per_epoch)
-    parsigex = ParSigEx(share_idx, parsig_transport, verifier)
+    parsigex = ParSigEx(
+        share_idx, parsig_transport, verifier, gater=duty_gater
+    )
     scheduler = Scheduler(
         beacon,
         clock,
@@ -214,10 +225,21 @@ async def build_node(config: Config) -> Node:
     )
 
     # deadliner trims stores + triggers tracker analysis
-    deadliner = Deadliner(clock, _make_expiry(dutydb, parsigdb, aggsigdb, tracker))
+    deadliner = Deadliner(
+        clock,
+        _make_expiry(dutydb, parsigdb, aggsigdb, tracker, qbft_consensus),
+    )
     scheduler.subscribe_duties(_register_deadline(deadliner))
 
-    vapi_router = VapiRouter(vapi)
+    vapi_router = VapiRouter(
+        vapi,
+        beacon=beacon,
+        validators=validators,
+        genesis_time=config.genesis_time or 0.0,
+        slots_per_epoch=config.slots_per_epoch,
+        slot_duration=config.slot_duration,
+        clock=clock,
+    )
 
     # -- lifecycle hooks --------------------------------------------------
     async def start_vapi():
@@ -263,11 +285,13 @@ async def build_node(config: Config) -> Node:
     )
 
 
-def _make_expiry(dutydb, parsigdb, aggsigdb, tracker):
+def _make_expiry(dutydb, parsigdb, aggsigdb, tracker, consensus=None):
     async def on_expired(duty):
         dutydb.trim(duty)
         parsigdb.trim(duty)
         aggsigdb.trim(duty)
+        if consensus is not None:
+            consensus.trim(duty)
         await tracker.duty_expired(duty)
 
     return on_expired

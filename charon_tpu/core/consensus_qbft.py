@@ -57,9 +57,26 @@ class QBFTConsensus:
         nodes: int,
         round_timeout: float = 0.75,
         round_increase: float = 0.25,
+        privkey=None,
+        pubkeys: list[bytes] | None = None,
+        gater=None,
     ) -> None:
+        """`privkey`/`pubkeys` enable per-message k1 authentication
+        (ref: core/consensus/qbft/transport.go:25-50 signs every msg,
+        qbft.go:561 verifies each incl. piggybacked justifications). When
+        provided, every outbound message is signed over qbft.msg_digest and
+        every inbound message — and each of its justification messages — is
+        verified against the per-index cluster pubkeys before the engine
+        counts it."""
         self.net = net
         self.node_idx = net.attach(self)
+        self._privkey = privkey
+        self._pubkeys = pubkeys
+        # Duty gater: without it, deliver() would create transports and
+        # value caches for ANY duty a byzantine-but-authenticated peer
+        # names — unbounded memory (ref: consensus also gates inbound
+        # duties, core/consensus/qbft/qbft.go handle()).
+        self._gater = gater
 
         def leader(instance, rnd: int) -> int:
             """Deterministic round-robin (ref: qbft.go:706)."""
@@ -68,21 +85,61 @@ class QBFTConsensus:
             )
             return (h + rnd) % nodes
 
+        def sign_msg(m: qbft.Msg) -> qbft.Msg:
+            if privkey is None:
+                return m
+            from dataclasses import replace
+
+            from charon_tpu.app import k1util
+
+            return replace(
+                m, signature=k1util.sign(privkey, qbft.msg_digest(m))
+            )
+
+        def is_valid(m: qbft.Msg) -> bool:
+            if pubkeys is None:
+                return True
+            return self._verify_msg(m, check_justification=True)
+
         self.defn = qbft.Definition(
             nodes=nodes,
             leader=leader,
             # ref-equivalent increasing round timer
             # (core/consensus/utils/roundtimer.go:17-19)
             timeout=lambda r: round_timeout + round_increase * r,
+            is_valid=is_valid,
+            sign_msg=sign_msg,
         )
         self._subs: list[DecidedSub] = []
-        self._values: dict[bytes, dict[PubKey, object]] = {}
+        # Per-duty values-by-hash cache: messages for one instance carry
+        # only that instance's candidate values (ref: transport.go:63-90
+        # keeps values per consensus instance, not globally).
+        self._values: dict[Duty, dict[bytes, dict[PubKey, object]]] = {}
         self._instances: dict[Duty, qbft.Transport] = {}
         self._running: dict[Duty, asyncio.Task] = {}
         self._decided: set[Duty] = set()
 
     def subscribe(self, sub: DecidedSub) -> None:
         self._subs.append(sub)
+
+    def _verify_msg(self, m: qbft.Msg, check_justification: bool) -> bool:
+        """Signature check against the sender's cluster pubkey; recurses
+        into justification messages so a byzantine leader cannot fabricate
+        quorums of piggybacked ROUND-CHANGE/PREPARE messages
+        (ref: core/consensus/qbft/qbft.go:561)."""
+        from charon_tpu.app import k1util
+
+        if not (0 <= m.source < len(self._pubkeys)):
+            return False
+        if not k1util.verify_bytes(
+            self._pubkeys[m.source], qbft.msg_digest(m), m.signature
+        ):
+            return False
+        if check_justification:
+            for j in m.justification:
+                if not self._verify_msg(j, check_justification=False):
+                    return False
+        return True
 
     # -- engine plumbing ---------------------------------------------------
 
@@ -92,7 +149,10 @@ class QBFTConsensus:
 
             async def bcast(msg: qbft.Msg) -> None:
                 await self.net.broadcast(
-                    self.node_idx, duty, msg, dict(self._values)
+                    self.node_idx,
+                    duty,
+                    msg,
+                    dict(self._values.get(duty, {})),
                 )
 
             tr = qbft.Transport(bcast)
@@ -100,9 +160,31 @@ class QBFTConsensus:
         return tr
 
     def deliver(self, duty: Duty, msg: qbft.Msg, values) -> None:
-        """Incoming message from the fabric; values-by-hash cache merge."""
-        self._values.update(values)
-        self._transport(duty).inbox.put_nowait(msg)
+        """Incoming message from the fabric; values-by-hash cache merge.
+
+        Each received value is re-hashed and inserted only under its
+        *recomputed* key, and existing entries are never overwritten — a
+        peer cannot bind a decided hash to substituted duty data
+        (ref: core/consensus/qbft/qbft.go valuesByHash recomputes)."""
+        if self._gater is not None and not self._gater(duty):
+            return
+        # Inbox first: if the sender is over its per-source buffer bound,
+        # its value payloads are dropped too — otherwise the cache merge
+        # would be an unbounded-memory side channel around the bound.
+        if not self._transport(duty).receive(msg):
+            return
+        cache = self._values.setdefault(duty, {})
+        # One honest node contributes one candidate value per instance, so
+        # an honest cache never exceeds n entries; cap at 2n.
+        max_values = 2 * self.defn.nodes
+        for v in values.values():
+            if len(cache) >= max_values:
+                break
+            try:
+                rh = value_hash(v)
+            except Exception:
+                continue
+            cache.setdefault(rh, v)
 
     def _ensure_running(self, duty: Duty, value_hash_or_none) -> asyncio.Task:
         task = self._running.get(duty)
@@ -121,7 +203,7 @@ class QBFTConsensus:
         if duty in self._decided:
             return
         self._decided.add(duty)
-        unsigned_set = self._values.get(decided_hash)
+        unsigned_set = self._values.get(duty, {}).get(decided_hash)
         if unsigned_set is None:
             raise RuntimeError(
                 f"decided hash with no value in cache for {duty}"
@@ -129,12 +211,21 @@ class QBFTConsensus:
         for sub in self._subs:
             await sub(duty, unsigned_set)
 
+    def trim(self, duty: Duty) -> None:
+        """Drop instance state for an expired duty (Deadliner hook)."""
+        self._values.pop(duty, None)
+        self._instances.pop(duty, None)
+        task = self._running.pop(duty, None)
+        if task is not None and not task.done():
+            task.cancel()
+        self._decided.discard(duty)
+
     # -- workflow API ------------------------------------------------------
 
     async def propose(self, duty: Duty, unsigned_set: dict[PubKey, object]) -> None:
         """ref: core/consensus/qbft/qbft.go:247 Propose."""
         vhash = value_hash(unsigned_set)
-        self._values[vhash] = unsigned_set
+        self._values.setdefault(duty, {})[vhash] = unsigned_set
         task = self._ensure_running(duty, vhash)
         await asyncio.shield(task)
 

@@ -10,15 +10,59 @@ component via the p2p layer.
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
 from typing import Awaitable, Callable
 
 from charon_tpu import tbls
+from charon_tpu.core.deadline import LATE_FACTOR, SlotClock
 from charon_tpu.core.eth2data import ParSignedData
-from charon_tpu.core.types import Duty, PubKey
+from charon_tpu.core.types import Duty, DutyType, PubKey
 from charon_tpu.eth2util.signing import ForkInfo
 
 ExSub = Callable[[Duty, dict[PubKey, ParSignedData]], Awaitable[None]]
+
+
+class DutyGater:
+    """Rejects expired or far-future duties before any crypto runs
+    (ref: core/parsigex/parsigex.go:81 wires core.NewDutyGater,
+    core/gater.go:38-79): a peer flooding stale-slot sets must not reach
+    the batch verifier — free DoS amplification on the crypto plane
+    otherwise.
+
+    Future bound is epoch-granular like the reference (duty epoch within
+    allowed_future_epochs of current, gater.go:72-78); the stale bound
+    (slot older than LATE_FACTOR, matching the Deadliner's expiry window,
+    core/deadline.go:23-26) goes beyond the reference and is skipped for
+    epoch-scale duty types (exits, builder registrations) whose slots
+    legitimately lag."""
+
+    ALLOWED_FUTURE_EPOCHS = 2  # ref: core/gater.go defaultAllowedFutureEpochs
+
+    _EPOCH_SCALE = (DutyType.EXIT, DutyType.BUILDER_REGISTRATION)
+
+    def __init__(
+        self,
+        clock: SlotClock,
+        slots_per_epoch: int = 32,
+        now: Callable[[], float] = time.time,
+    ) -> None:
+        self._clock = clock
+        self._spe = slots_per_epoch
+        self._now = now
+
+    def __call__(self, duty: Duty) -> bool:
+        if not isinstance(duty.type, DutyType) or duty.type == DutyType.UNKNOWN:
+            return False
+        current = self._clock.slot_at(self._now())
+        if (
+            duty.slot // self._spe
+            > current // self._spe + self.ALLOWED_FUTURE_EPOCHS
+        ):
+            return False
+        if duty.type in self._EPOCH_SCALE:
+            return True
+        return duty.slot >= current - LATE_FACTOR
 
 
 class Eth2Verifier:
@@ -69,10 +113,13 @@ class ParSigEx:
         share_idx: int,
         transport: MemTransport,
         verifier: Eth2Verifier | None = None,
+        gater: Callable[[Duty], bool] | None = None,
     ) -> None:
         self.share_idx = share_idx
         self.transport = transport
         self.verifier = verifier
+        self.gater = gater
+        self.dropped_stale = 0  # metric: sets gated before crypto
         self._subs: list[ExSub] = []
         transport.attach(self)
 
@@ -84,7 +131,12 @@ class ParSigEx:
         await self.transport.send(self.share_idx, duty, signed_set)
 
     async def receive(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> None:
-        """Peer partials arrive; verify then store (ref: parsigex.go:68-109)."""
+        """Peer partials arrive; gate, verify, then store
+        (ref: parsigex.go:68-109). The gater runs *before* signature
+        verification so stale floods never reach the batch verifier."""
+        if self.gater is not None and not self.gater(duty):
+            self.dropped_stale += 1
+            return
         if self.verifier is not None and not self.verifier.verify(duty, signed_set):
             return  # drop invalid sets (logged/tracked in the full stack)
         for sub in self._subs:

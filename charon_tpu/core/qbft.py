@@ -40,7 +40,14 @@ class Msg:
     """One QBFT message. `value` is the proposed value (hashable; the
     adapter layer uses 32-byte hashes with values carried out-of-band, ref:
     core/consensus/qbft/transport.go values-by-hash). Justification carries
-    piggybacked messages for PRE-PREPARE/ROUND-CHANGE rules."""
+    piggybacked messages for PRE-PREPARE/ROUND-CHANGE rules.
+
+    `signature` authenticates the message independently of the channel it
+    arrived on (ref: core/consensus/qbft/transport.go:25-50 signs every
+    msg; qbft.go:561 verifies) — required because justification messages
+    are relayed by third parties, so channel auth alone cannot vouch for
+    their claimed sources. The engine treats it as opaque; signing happens
+    via Definition.sign_msg and verification via Definition.is_valid."""
 
     type: MsgType
     instance: Hashable
@@ -50,6 +57,33 @@ class Msg:
     prepared_round: int = 0
     prepared_value: Hashable | None = None
     justification: tuple["Msg", ...] = ()
+    signature: bytes = b""
+
+
+def msg_digest(msg: Msg) -> bytes:
+    """Deterministic 32-byte digest of a message, excluding its signature.
+
+    Justification messages contribute their own digests *and* signatures,
+    binding the exact set of piggybacked (already-signed) messages to the
+    outer signature."""
+    import hashlib
+
+    just = tuple(
+        (msg_digest(j), j.signature) for j in msg.justification
+    )
+    material = repr(
+        (
+            int(msg.type),
+            msg.instance,
+            msg.source,
+            msg.round,
+            msg.value,
+            msg.prepared_round,
+            msg.prepared_value,
+            just,
+        )
+    ).encode()
+    return hashlib.sha256(material).digest()
 
 
 @dataclass
@@ -60,7 +94,12 @@ class Definition:
     leader: Callable[[Hashable, int], int]  # (instance, round) -> node idx
     # round -> timeout seconds (ref-equivalent default: 0.75 + 0.25*round)
     timeout: Callable[[int], float] = lambda r: 0.75 + 0.25 * r
+    # Authenticates a message (signature over msg_digest against the
+    # per-index cluster key) AND, for messages carrying justifications,
+    # each piggybacked message (ref: qbft.go:561 verifies wrapped msgs).
     is_valid: Callable[[Msg], bool] = lambda m: True
+    # Applied to every outbound message before broadcast/loopback.
+    sign_msg: Callable[[Msg], Msg] = lambda m: m
 
     @property
     def quorum(self) -> int:
@@ -72,11 +111,36 @@ class Definition:
 
 
 class Transport:
-    """Broadcast + inbound queue. The engine owns no sockets."""
+    """Broadcast + inbound queue. The engine owns no sockets.
 
-    def __init__(self, broadcast: Callable[[Msg], Awaitable[None]]):
+    The inbox is bounded per source (ref: core/qbft bounds the per-peer
+    FIFO) so one byzantine peer cannot grow memory without limit: messages
+    beyond `max_buffered_per_source` outstanding from one source are
+    dropped at receive time."""
+
+    def __init__(
+        self,
+        broadcast: Callable[[Msg], Awaitable[None]],
+        max_buffered_per_source: int = 128,
+    ):
         self.broadcast = broadcast
         self.inbox: asyncio.Queue[Msg] = asyncio.Queue()
+        self.max_buffered_per_source = max_buffered_per_source
+        self._buffered: dict[int, int] = {}
+
+    def receive(self, msg: Msg) -> bool:
+        """Enqueue an inbound message; False = dropped (source over bound)."""
+        n = self._buffered.get(msg.source, 0)
+        if n >= self.max_buffered_per_source:
+            return False
+        self._buffered[msg.source] = n + 1
+        self.inbox.put_nowait(msg)
+        return True
+
+    def _consumed(self, msg: Msg) -> None:
+        n = self._buffered.get(msg.source, 0)
+        if n > 0:
+            self._buffered[msg.source] = n - 1
 
 
 async def run(
@@ -135,6 +199,7 @@ class _Engine:
         return None
 
     async def _send(self, msg: Msg) -> None:
+        msg = self.d.sign_msg(msg)
         await self.t.broadcast(msg)
         # Loopback: our own message must also drive the upon-rules (it may
         # be the final piece of a quorum). Recursion is bounded by the
@@ -147,10 +212,28 @@ class _Engine:
             return False
         if not (0 <= msg.source < self.d.nodes):
             return False
-        if not self.d.is_valid(msg):
-            return False
+        # Dedup BEFORE signature verification: replaying an already-stored
+        # message must not cost ECDSA verifies (a justification-laden msg
+        # carries ~2*quorum signatures — free CPU amplification otherwise).
         key = (msg.type, msg.source, msg.round)
         if key in self.msgs:
+            return False
+        # Bound + dedup justifications BEFORE signature verification: a
+        # protocol-honest PRE-PREPARE carries at most a ROUND-CHANGE quorum
+        # plus a PREPARE quorum (<= 2n distinct (type, source, round)
+        # slots); anything larger or duplicated is a CPU-amplification
+        # attack (each entry costs an ECDSA verify).
+        if len(msg.justification) > 2 * self.d.nodes:
+            return False
+        seen: set = set()
+        for j in msg.justification:
+            if not (0 <= j.source < self.d.nodes):
+                return False
+            jkey = (j.type, j.source, j.round)
+            if jkey in seen:
+                return False
+            seen.add(jkey)
+        if not self.d.is_valid(msg):
             return False
         self.msgs[key] = msg
         return True
@@ -186,10 +269,14 @@ class _Engine:
         if msg.value != best.prepared_value:
             return False
         # the claimed prepared value must be backed by a PREPARE quorum
+        # FROM THIS INSTANCE — without the instance check a byzantine
+        # leader could replay a validly-signed PREPARE quorum recorded in
+        # a different instance to justify a foreign value here
         prepares = [
             j
             for j in msg.justification
             if j.type == MsgType.PREPARE
+            and j.instance == self.instance
             and j.round == best.prepared_round
             and j.value == best.prepared_value
         ]
@@ -240,17 +327,34 @@ class _Engine:
                     get.cancel()
                     break
                 msg = get.result()
+                self.t._consumed(msg)
                 prev_round = self.round
                 if self._accept(msg):
                     await self._on_msg(msg)
                 if self.round != prev_round:
                     restart_timer()
+                    # Messages for the new round may already be buffered in
+                    # self.msgs (they arrived while we were behind); re-run
+                    # the upon-rules against the stored state.
+                    await self._reevaluate()
             return self.decided.result()
         finally:
             if timer_task is not None:
                 timer_task.cancel()
             if value_task is not None:
                 value_task.cancel()
+
+    async def _reevaluate(self) -> None:
+        """Re-run upon-rules for the current round against stored messages
+        (after a round catch-up, quorums may already be present)."""
+        for m in self._collect(MsgType.PRE_PREPARE, self.round):
+            await self._on_msg(m)
+        for m in self._collect(MsgType.PREPARE, self.round)[:1]:
+            await self._on_msg(m)
+        for rnd in {r for (t, _, r) in self.msgs if t == MsgType.COMMIT}:
+            for m in self._collect(MsgType.COMMIT, rnd)[:1]:
+                await self._on_msg(m)
+        await self._maybe_propose()
 
     async def _maybe_propose(self) -> None:
         """Leader of round 1 sends the PRE-PREPARE when it has a value."""

@@ -187,6 +187,35 @@ class Scheduler:
                 pubkey=pd["pubkey"],
                 validator_index=pd["validator_index"],
             )
+        # Aggregator duties mirror attester duties at the ⅔-slot offset —
+        # every attester is a potential aggregator; actual selection is
+        # decided by the aggregated selection proof (ref: scheduler
+        # resolveAttDuties also schedules DutyAggregator,
+        # core/scheduler/scheduler.go:246+).
+        for duty, defs in [
+            (d, v) for d, v in out.items() if d.type == DutyType.ATTESTER
+        ]:
+            out[Duty(duty.slot, DutyType.AGGREGATOR)] = dict(defs)
+        # Sync-committee membership spans the epoch: one SYNC_MESSAGE and
+        # one SYNC_CONTRIBUTION duty per slot for each member
+        # (ref: scheduler.go resolveSyncCommDuties).
+        if hasattr(self.beacon, "sync_duties"):
+            sync = await self.beacon.sync_duties(epoch, self.validators)
+            for slot in range(
+                epoch * self.slots_per_epoch, (epoch + 1) * self.slots_per_epoch
+            ):
+                for sd in sync:
+                    d = DutyDefinition(
+                        pubkey=sd["pubkey"],
+                        validator_index=sd["validator_index"],
+                        committee_index=sd.get("subcommittee_index", 0),
+                    )
+                    out.setdefault(
+                        Duty(slot, DutyType.SYNC_MESSAGE), {}
+                    )[sd["pubkey"]] = d
+                    out.setdefault(
+                        Duty(slot, DutyType.SYNC_CONTRIBUTION), {}
+                    )[sd["pubkey"]] = d
         self._defs[epoch] = out
         # keep two epochs of definitions
         for old in [e for e in self._defs if e < epoch - 1]:

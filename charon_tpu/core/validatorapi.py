@@ -52,6 +52,7 @@ class ValidatorAPI:
         self._await_sync_msg = None
         self._pubkey_by_att = None
         self._duty_defs = None
+        self._await_aggregated = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -78,6 +79,12 @@ class ValidatorAPI:
 
     def register_get_duty_definition(self, fn) -> None:
         self._duty_defs = fn
+
+    def register_await_aggregated(self, fn) -> None:
+        """AggSigDB await — serves aggregated selection proofs back to the
+        VC (ref: validatorapi.go:724 AggregateBeaconCommitteeSelections
+        returns combined selections, not partials)."""
+        self._await_aggregated = fn
 
     # -- queries (VC pulls duty data; blocking until consensus) ------------
 
@@ -157,6 +164,49 @@ class ValidatorAPI:
             [self._verify_item(pubkey, signed, agg.aggregate.data.slot)]
         )
         duty = Duty(agg.aggregate.data.slot, DutyType.AGGREGATOR)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def aggregate_selection(self, slot: int, pubkey: PubKey):
+        """Blocking fetch of the threshold-aggregated beacon-committee
+        selection proof (ref: validatorapi.go:724 returns the combined
+        proof after cluster-wide aggregation)."""
+        duty = Duty(slot, DutyType.PREPARE_AGGREGATOR)
+        return await self._await_aggregated(duty, pubkey)
+
+    async def submit_sync_selection(
+        self, slot: int, subcommittee_index: int, pubkey: PubKey, signature: bytes
+    ) -> None:
+        """Sync-committee selection partials
+        (ref: validatorapi.go AggregateSyncCommitteeSelections)."""
+        from charon_tpu.core.eth2data import SyncSelectionData
+
+        payload = SyncSelectionData(slot, subcommittee_index)
+        signed = SignedData("sync_selection", payload, signature)
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
+        for sub in self._subs:
+            await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
+
+    async def sync_selection_aggregate(self, slot: int, pubkey: PubKey):
+        duty = Duty(slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
+        return await self._await_aggregated(duty, pubkey)
+
+    async def sync_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: bytes
+    ):
+        """Blocking fetch of the cluster-agreed sync contribution."""
+        return await self._await_contrib(
+            slot, subcommittee_index, beacon_block_root
+        )
+
+    async def submit_contribution_and_proof(
+        self, pubkey: PubKey, cap, signature: bytes
+    ) -> None:
+        signed = SignedData("contribution_and_proof", cap, signature)
+        slot = cap.contribution.slot
+        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        duty = Duty(slot, DutyType.SYNC_CONTRIBUTION)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
 

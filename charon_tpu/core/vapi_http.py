@@ -1,11 +1,23 @@
 """HTTP router for the ValidatorAPI: the eth2 beacon API served to VCs.
 
-Mirrors ref: core/validatorapi/router.go:97-253 — the intercepted endpoint
-set (attestation data, attestation submission, proposals, randao via the
-proposal flow, duties, node endpoints) served locally with blocking
-awaits; everything else would proxy to the upstream beacon node
-(proxy handler router.go; here: 501 with a clear error until the proxy
-lands).
+Mirrors ref: core/validatorapi/router.go:97-253 — the full intercepted
+endpoint set served locally with blocking awaits:
+
+  attester:    attestation_data, submit attestations
+  proposer:    v3 blocks (randao partial via query param), submit
+               (blinded) blocks
+  aggregator:  beacon-committee selections (partials in, aggregated out),
+               aggregate_attestation, aggregate_and_proofs
+  sync:        sync duties, sync-committee messages, sync-committee
+               selections, contribution, contribution_and_proofs
+  lifecycle:   validators (pubshare <-> group pubkey mapping), duties
+               (attester/proposer/sync), registrations, voluntary exit,
+               prepare_beacon_proposer, subscriptions, genesis/spec/fork,
+               node version/health/syncing
+
+Everything else 404s with a clear error (the reference proxies unknown
+routes to the upstream BN, router.go proxyHandler; the simnet beacon mock
+serves no extra routes worth proxying).
 
 JSON schema follows the eth2 beacon API shapes for the implemented
 endpoints (integers as strings, 0x-hex byte fields).
@@ -19,28 +31,41 @@ from dataclasses import dataclass
 from aiohttp import web
 
 from charon_tpu.core.eth2data import (
+    AggregateAndProof,
     Attestation,
     AttestationData,
+    BeaconBlockHeader,
     Checkpoint,
+    ContributionAndProof,
     Proposal,
+    SyncCommitteeContribution,
+    SyncCommitteeMessage,
+    ValidatorRegistration,
+    VoluntaryExit,
 )
-from charon_tpu.core.types import PubKey
+from charon_tpu.core.types import Duty, DutyType, PubKey
 from charon_tpu.core.validatorapi import ValidatorAPI, VapiError
+
+# ---------------------------------------------------------------------------
+# JSON codecs (eth2 beacon API shapes)
+# ---------------------------------------------------------------------------
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+def _unhex(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
 
 
 def _att_data_json(d: AttestationData) -> dict:
     return {
         "slot": str(d.slot),
         "index": str(d.index),
-        "beacon_block_root": "0x" + d.beacon_block_root.hex(),
-        "source": {
-            "epoch": str(d.source.epoch),
-            "root": "0x" + d.source.root.hex(),
-        },
-        "target": {
-            "epoch": str(d.target.epoch),
-            "root": "0x" + d.target.root.hex(),
-        },
+        "beacon_block_root": _hex(d.beacon_block_root),
+        "source": {"epoch": str(d.source.epoch), "root": _hex(d.source.root)},
+        "target": {"epoch": str(d.target.epoch), "root": _hex(d.target.root)},
     }
 
 
@@ -48,24 +73,19 @@ def _att_data_from_json(j: dict) -> AttestationData:
     return AttestationData(
         slot=int(j["slot"]),
         index=int(j["index"]),
-        beacon_block_root=bytes.fromhex(j["beacon_block_root"][2:]),
-        source=Checkpoint(
-            int(j["source"]["epoch"]), bytes.fromhex(j["source"]["root"][2:])
-        ),
-        target=Checkpoint(
-            int(j["target"]["epoch"]), bytes.fromhex(j["target"]["root"][2:])
-        ),
+        beacon_block_root=_unhex(j["beacon_block_root"]),
+        source=Checkpoint(int(j["source"]["epoch"]), _unhex(j["source"]["root"])),
+        target=Checkpoint(int(j["target"]["epoch"]), _unhex(j["target"]["root"])),
     )
 
 
 def _bits_from_hex(hexstr: str) -> tuple[bool, ...]:
     """Eth2 SSZ bitlist hex -> bool tuple (delimiter bit trimmed)."""
-    raw = bytes.fromhex(hexstr[2:])
+    raw = _unhex(hexstr)
     bits = []
     for byte in raw:
         for i in range(8):
             bits.append(bool(byte >> i & 1))
-    # strip from the last set bit (the length delimiter)
     while bits and not bits[-1]:
         bits.pop()
     if bits:
@@ -82,20 +102,215 @@ def _bits_to_hex(bits: tuple[bool, ...]) -> str:
     return "0x" + bytes(data).hex()
 
 
+def _bitvector_to_hex(bits: tuple[bool, ...], size: int = 128) -> str:
+    full = list(bits) + [False] * (size - len(bits))
+    data = bytearray(size // 8)
+    for i, b in enumerate(full[:size]):
+        if b:
+            data[i // 8] |= 1 << (i % 8)
+    return "0x" + bytes(data).hex()
+
+
+def _bitvector_from_hex(hexstr: str, size: int = 128) -> tuple[bool, ...]:
+    raw = _unhex(hexstr)
+    bits = []
+    for byte in raw:
+        for i in range(8):
+            bits.append(bool(byte >> i & 1))
+    return tuple(bits[:size])
+
+
+def _attestation_json(a: Attestation) -> dict:
+    return {
+        "aggregation_bits": _bits_to_hex(a.aggregation_bits),
+        "data": _att_data_json(a.data),
+        "signature": _hex(a.signature),
+    }
+
+
+def _attestation_from_json(j: dict) -> Attestation:
+    return Attestation(
+        aggregation_bits=_bits_from_hex(j["aggregation_bits"]),
+        data=_att_data_from_json(j["data"]),
+        signature=_unhex(j["signature"]),
+    )
+
+
+def _header_json(h: BeaconBlockHeader) -> dict:
+    return {
+        "slot": str(h.slot),
+        "proposer_index": str(h.proposer_index),
+        "parent_root": _hex(h.parent_root),
+        "state_root": _hex(h.state_root),
+        "body_root": _hex(h.body_root),
+    }
+
+
+def _header_from_json(j: dict) -> BeaconBlockHeader:
+    return BeaconBlockHeader(
+        slot=int(j["slot"]),
+        proposer_index=int(j["proposer_index"]),
+        parent_root=_unhex(j["parent_root"]),
+        state_root=_unhex(j["state_root"]),
+        body_root=_unhex(j["body_root"]),
+    )
+
+
+def _proposal_json(p: Proposal) -> dict:
+    return {
+        "header": _header_json(p.header),
+        "body": _hex(p.body),
+        "blinded": p.blinded,
+    }
+
+
+def _proposal_from_json(j: dict) -> Proposal:
+    return Proposal(
+        header=_header_from_json(j["header"]),
+        body=_unhex(j["body"]),
+        blinded=bool(j.get("blinded", False)),
+    )
+
+
+def _contribution_json(c: SyncCommitteeContribution) -> dict:
+    return {
+        "slot": str(c.slot),
+        "beacon_block_root": _hex(c.beacon_block_root),
+        "subcommittee_index": str(c.subcommittee_index),
+        "aggregation_bits": _bitvector_to_hex(c.aggregation_bits),
+        "signature": _hex(c.signature),
+    }
+
+
+def _contribution_from_json(j: dict) -> SyncCommitteeContribution:
+    return SyncCommitteeContribution(
+        slot=int(j["slot"]),
+        beacon_block_root=_unhex(j["beacon_block_root"]),
+        subcommittee_index=int(j["subcommittee_index"]),
+        aggregation_bits=_bitvector_from_hex(j["aggregation_bits"]),
+        signature=_unhex(j["signature"]),
+    )
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response({"code": status, "message": message}, status=status)
+
+
 class VapiRouter:
-    def __init__(self, vapi: ValidatorAPI) -> None:
+    """vapi: the transport-agnostic component; beacon: duck-typed client
+    for duties resolution; validators: group pubkey -> validator index."""
+
+    def __init__(
+        self,
+        vapi: ValidatorAPI,
+        beacon=None,
+        validators: dict[PubKey, int] | None = None,
+        genesis_time: float = 0.0,
+        slots_per_epoch: int = 32,
+        slot_duration: float = 12.0,
+        clock=None,
+    ) -> None:
+        from charon_tpu.core.deadline import SlotClock
+
         self.vapi = vapi
+        self.beacon = beacon
+        self.validators = validators or {}
+        self.genesis_time = genesis_time
+        self.slots_per_epoch = slots_per_epoch
+        self.slot_duration = slot_duration
+        self.clock = clock or SlotClock(genesis_time, max(slot_duration, 1e-9))
+        # pubshare (this node's) -> group pubkey, for VC keystore lookups
+        # (ref: validatorapi.go:1080,1167 pubshare<->group mapping)
+        self._group_by_pubshare = {
+            "0x" + ps.hex(): gpk for gpk, ps in vapi.pubshares.items()
+        }
+        self._pubkey_by_index = {
+            i: pk for pk, i in self.validators.items()
+        }
         self.app = web.Application()
         self.app.add_routes(
             [
+                # attester (ref: router.go:115,121)
+                web.get("/eth/v1/validator/attestation_data", self._attestation_data),
+                web.post("/eth/v1/beacon/pool/attestations", self._submit_attestations),
+                web.post("/eth/v2/beacon/pool/attestations", self._submit_attestations),
+                # proposer (ref: router.go:151,157-175)
+                web.get("/eth/v3/validator/blocks/{slot}", self._produce_block_v3),
+                web.post("/eth/v1/beacon/blocks", self._submit_block),
+                web.post("/eth/v2/beacon/blocks", self._submit_block),
+                web.post("/eth/v1/beacon/blinded_blocks", self._submit_block),
+                web.post("/eth/v2/beacon/blinded_blocks", self._submit_block),
+                # aggregator (ref: router.go:127-145, validatorapi.go:724)
+                web.post(
+                    "/eth/v1/validator/beacon_committee_selections",
+                    self._beacon_committee_selections,
+                ),
                 web.get(
-                    "/eth/v1/validator/attestation_data", self._attestation_data
+                    "/eth/v1/validator/aggregate_attestation",
+                    self._aggregate_attestation,
+                ),
+                web.get(
+                    "/eth/v2/validator/aggregate_attestation",
+                    self._aggregate_attestation,
                 ),
                 web.post(
-                    "/eth/v1/beacon/pool/attestations", self._submit_attestations
+                    "/eth/v1/validator/aggregate_and_proofs",
+                    self._aggregate_and_proofs,
                 ),
+                web.post(
+                    "/eth/v2/validator/aggregate_and_proofs",
+                    self._aggregate_and_proofs,
+                ),
+                # sync committee (ref: router.go:181-205)
+                web.post("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages),
+                web.post(
+                    "/eth/v1/validator/sync_committee_selections",
+                    self._sync_committee_selections,
+                ),
+                web.get(
+                    "/eth/v1/validator/sync_committee_contribution",
+                    self._sync_contribution,
+                ),
+                web.post(
+                    "/eth/v1/validator/contribution_and_proofs",
+                    self._contribution_and_proofs,
+                ),
+                # registrations / exits (ref: router.go:211-223)
+                web.post("/eth/v1/validator/register_validator", self._register_validator),
+                web.post("/eth/v1/beacon/pool/voluntary_exits", self._voluntary_exit),
+                # duties (ref: router.go:97-113)
+                web.post("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties),
+                web.get("/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties),
+                web.post("/eth/v1/validator/duties/sync/{epoch}", self._sync_duties),
+                # validators mapping (ref: validatorapi.go:1080)
+                web.get(
+                    "/eth/v1/beacon/states/{state_id}/validators", self._get_validators
+                ),
+                web.post(
+                    "/eth/v1/beacon/states/{state_id}/validators", self._get_validators
+                ),
+                web.get(
+                    "/eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+                    self._get_validator,
+                ),
+                # accepted no-ops the VC expects 200 from
+                web.post("/eth/v1/validator/prepare_beacon_proposer", self._ok),
+                web.post("/eth/v1/validator/beacon_committee_subscriptions", self._ok),
+                web.post("/eth/v1/validator/sync_committee_subscriptions", self._ok),
+                # head block root for sync-committee messages — blocks on
+                # the cluster-agreed SYNC_MESSAGE root so every node's VC
+                # signs the same root (the reference proxies this to the BN
+                # and relies on BN agreement; consensus is this framework's
+                # redesign for the same endpoint)
+                web.get("/eth/v1/beacon/blocks/head/root", self._head_root),
+                # node / chain metadata
                 web.get("/eth/v1/node/version", self._node_version),
                 web.get("/eth/v1/node/syncing", self._syncing),
+                web.get("/eth/v1/node/health", self._health),
+                web.get("/eth/v1/beacon/genesis", self._genesis),
+                web.get("/eth/v1/config/spec", self._spec),
+                web.get("/eth/v1/config/fork_schedule", self._fork_schedule),
+                web.get("/eth/v1/beacon/states/{state_id}/fork", self._state_fork),
             ]
         )
         self._runner: web.AppRunner | None = None
@@ -111,7 +326,17 @@ class VapiRouter:
         if self._runner:
             await self._runner.cleanup()
 
-    # -- handlers ---------------------------------------------------------
+    # -- pubkey resolution -------------------------------------------------
+
+    def _resolve_pubkey(self, pk_hex: str) -> PubKey:
+        """Accept a group pubkey or this node's pubshare for it
+        (the VC's keystores hold pubshares, ref: validatorapi.go:1167)."""
+        pk_hex = pk_hex.lower()
+        if pk_hex in self._group_by_pubshare:
+            return self._group_by_pubshare[pk_hex]
+        return PubKey(pk_hex)
+
+    # -- attester ----------------------------------------------------------
 
     async def _attestation_data(self, request: web.Request) -> web.Response:
         """ref: router.go:115 attestation_data -> blocking DutyDB await."""
@@ -119,45 +344,491 @@ class VapiRouter:
             slot = int(request.query["slot"])
             committee_index = int(request.query["committee_index"])
         except (KeyError, ValueError):
-            return web.json_response(
-                {"code": 400, "message": "slot and committee_index required"},
-                status=400,
-            )
+            return _err(400, "slot and committee_index required")
         try:
             data = await self.vapi.attestation_data(slot, committee_index)
         except VapiError as e:
-            return web.json_response({"code": 404, "message": str(e)}, status=404)
+            return _err(404, str(e))
         return web.json_response({"data": _att_data_json(data)})
 
     async def _submit_attestations(self, request: web.Request) -> web.Response:
         """ref: router.go:121 + validatorapi.go:274."""
         try:
             body = await request.json()
-            atts = [
-                Attestation(
-                    aggregation_bits=_bits_from_hex(a["aggregation_bits"]),
-                    data=_att_data_from_json(a["data"]),
-                    signature=bytes.fromhex(a["signature"][2:]),
-                )
-                for a in body
-            ]
-        except (json.JSONDecodeError, KeyError, ValueError) as e:
-            return web.json_response(
-                {"code": 400, "message": f"malformed attestation: {e}"},
-                status=400,
-            )
+            if isinstance(body, dict):  # v2 shape {version, data}
+                body = body["data"]
+            atts = [_attestation_from_json(a) for a in body]
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed attestation: {e}")
         try:
             await self.vapi.submit_attestations(atts)
         except VapiError as e:
-            return web.json_response({"code": 400, "message": str(e)}, status=400)
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    # -- proposer ----------------------------------------------------------
+
+    async def _produce_block_v3(self, request: web.Request) -> web.Response:
+        """GET /eth/v3/validator/blocks/{slot}?randao_reveal=0x...
+
+        The randao reveal IS this node's partial randao signature; it is
+        verified + stored, the aggregated randao unblocks the proposal
+        fetcher, and the response blocks until cluster consensus on the
+        block (ref: validatorapi.go:335-399 Proposal)."""
+        try:
+            slot = int(request.match_info["slot"])
+            randao = _unhex(request.query["randao_reveal"])
+        except (KeyError, ValueError):
+            return _err(400, "slot and randao_reveal required")
+        defs = (
+            self.vapi._duty_defs(Duty(slot, DutyType.PROPOSER))
+            if self.vapi._duty_defs
+            else {}
+        )
+        if not defs:
+            return _err(404, f"no proposer duty at slot {slot}")
+        pubkey = next(iter(defs))
+        try:
+            await self.vapi.submit_randao(slot, pubkey, randao)
+            proposal = await self.vapi.proposal(slot, pubkey)
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.json_response(
+            {
+                "version": "deneb",
+                "execution_payload_blinded": proposal.blinded,
+                "execution_payload_value": "0",
+                "consensus_block_value": "0",
+                "data": _proposal_json(proposal),
+            }
+        )
+
+    async def _submit_block(self, request: web.Request) -> web.Response:
+        """ref: router.go:157-175 + validatorapi.go:490 SubmitProposal."""
+        try:
+            j = await request.json()
+            data = j["data"] if isinstance(j, dict) and "data" in j else j
+            proposal = _proposal_from_json(data["message"])
+            signature = _unhex(data["signature"])
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed block: {e}")
+        defs = (
+            self.vapi._duty_defs(
+                Duty(proposal.header.slot, DutyType.PROPOSER)
+            )
+            if self.vapi._duty_defs
+            else {}
+        )
+        if not defs:
+            return _err(404, f"no proposer duty at slot {proposal.header.slot}")
+        pubkey = next(iter(defs))
+        try:
+            await self.vapi.submit_proposal(pubkey, proposal, signature)
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    # -- aggregator --------------------------------------------------------
+
+    async def _beacon_committee_selections(self, request: web.Request) -> web.Response:
+        """Partial selection proofs in, threshold-aggregated proofs out
+        (ref: validatorapi.go:724 AggregateBeaconCommitteeSelections)."""
+        try:
+            body = await request.json()
+            parsed = [
+                (
+                    self._resolve_pubkey_by_index(int(s["validator_index"])),
+                    int(s["slot"]),
+                    _unhex(s["selection_proof"]),
+                )
+                for s in body
+            ]
+        except (
+            json.JSONDecodeError, KeyError, ValueError, TypeError, VapiError
+        ) as e:
+            return _err(400, f"malformed selections: {e}")
+        out = []
+        try:
+            for pubkey, slot, proof in parsed:
+                await self.vapi.submit_selection_proof(slot, pubkey, proof)
+            for pubkey, slot, _ in parsed:
+                agg = await self.vapi.aggregate_selection(slot, pubkey)
+                out.append(
+                    {
+                        "validator_index": str(self.validators.get(pubkey, 0)),
+                        "slot": str(slot),
+                        "selection_proof": _hex(agg.signature),
+                    }
+                )
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.json_response({"data": out})
+
+    async def _aggregate_attestation(self, request: web.Request) -> web.Response:
+        try:
+            slot = int(request.query["slot"])
+            root = _unhex(request.query["attestation_data_root"])
+        except (KeyError, ValueError):
+            return _err(400, "slot and attestation_data_root required")
+        try:
+            agg = await self.vapi.aggregate_attestation(slot, root)
+        except VapiError as e:
+            return _err(404, str(e))
+        # DutyDB stores the consensus AggregateAndProof; the endpoint
+        # serves the aggregate attestation inside it.
+        att = agg.aggregate if hasattr(agg, "aggregate") else agg
+        return web.json_response(
+            {"version": "deneb", "data": _attestation_json(att)}
+        )
+
+    async def _aggregate_and_proofs(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            if isinstance(body, dict):
+                body = body["data"]
+            items = []
+            for sap in body:
+                m = sap["message"]
+                agg = AggregateAndProof(
+                    aggregator_index=int(m["aggregator_index"]),
+                    aggregate=_attestation_from_json(m["aggregate"]),
+                    selection_proof=_unhex(m["selection_proof"]),
+                )
+                items.append((agg, _unhex(sap["signature"])))
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed aggregate: {e}")
+        try:
+            for agg, sig in items:
+                pubkey = self._resolve_pubkey_by_index(agg.aggregator_index)
+                await self.vapi.submit_aggregate_and_proof(pubkey, agg, sig)
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    # -- sync committee ----------------------------------------------------
+
+    async def _submit_sync_messages(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            msgs = [
+                SyncCommitteeMessage(
+                    slot=int(m["slot"]),
+                    beacon_block_root=_unhex(m["beacon_block_root"]),
+                    validator_index=int(m["validator_index"]),
+                    signature=_unhex(m["signature"]),
+                )
+                for m in body
+            ]
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed sync message: {e}")
+        try:
+            for m in msgs:
+                pubkey = self._resolve_pubkey_by_index(m.validator_index)
+                await self.vapi.submit_sync_message(
+                    m.slot, pubkey, m, m.signature
+                )
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    async def _sync_committee_selections(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            parsed = [
+                (
+                    self._resolve_pubkey_by_index(int(s["validator_index"])),
+                    int(s["slot"]),
+                    int(s["subcommittee_index"]),
+                    _unhex(s["selection_proof"]),
+                )
+                for s in body
+            ]
+        except (
+            json.JSONDecodeError, KeyError, ValueError, TypeError, VapiError
+        ) as e:
+            return _err(400, f"malformed selections: {e}")
+        out = []
+        try:
+            for pubkey, slot, subidx, proof in parsed:
+                await self.vapi.submit_sync_selection(slot, subidx, pubkey, proof)
+            for pubkey, slot, subidx, _ in parsed:
+                agg = await self.vapi.sync_selection_aggregate(slot, pubkey)
+                out.append(
+                    {
+                        "validator_index": str(self.validators.get(pubkey, 0)),
+                        "slot": str(slot),
+                        "subcommittee_index": str(subidx),
+                        "selection_proof": _hex(agg.signature),
+                    }
+                )
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.json_response({"data": out})
+
+    async def _sync_contribution(self, request: web.Request) -> web.Response:
+        try:
+            slot = int(request.query["slot"])
+            subidx = int(request.query["subcommittee_index"])
+            root = _unhex(request.query["beacon_block_root"])
+        except (KeyError, ValueError):
+            return _err(400, "slot, subcommittee_index, beacon_block_root required")
+        try:
+            contrib = await self.vapi.sync_contribution(slot, subidx, root)
+        except VapiError as e:
+            return _err(404, str(e))
+        return web.json_response({"data": _contribution_json(contrib)})
+
+    async def _contribution_and_proofs(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            items = []
+            for scp in body:
+                m = scp["message"]
+                cap = ContributionAndProof(
+                    aggregator_index=int(m["aggregator_index"]),
+                    contribution=_contribution_from_json(m["contribution"]),
+                    selection_proof=_unhex(m["selection_proof"]),
+                )
+                items.append((cap, _unhex(scp["signature"])))
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed contribution: {e}")
+        try:
+            for cap, sig in items:
+                pubkey = self._resolve_pubkey_by_index(cap.aggregator_index)
+                await self.vapi.submit_contribution_and_proof(pubkey, cap, sig)
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    # -- registrations / exits ---------------------------------------------
+
+    async def _register_validator(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            items = []
+            for r in body:
+                m = r["message"]
+                reg = ValidatorRegistration(
+                    fee_recipient=_unhex(m["fee_recipient"]),
+                    gas_limit=int(m["gas_limit"]),
+                    timestamp=int(m["timestamp"]),
+                    pubkey=_unhex(m["pubkey"]),
+                )
+                items.append((reg, _unhex(r["signature"])))
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed registration: {e}")
+        try:
+            for reg, sig in items:
+                pubkey = self._resolve_pubkey("0x" + reg.pubkey.hex())
+                await self.vapi.submit_registration(pubkey, reg, sig)
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    async def _voluntary_exit(self, request: web.Request) -> web.Response:
+        try:
+            j = await request.json()
+            exit_msg = VoluntaryExit(
+                epoch=int(j["message"]["epoch"]),
+                validator_index=int(j["message"]["validator_index"]),
+            )
+            signature = _unhex(j["signature"])
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            return _err(400, f"malformed exit: {e}")
+        try:
+            pubkey = self._resolve_pubkey_by_index(exit_msg.validator_index)
+            await self.vapi.submit_exit(pubkey, exit_msg, signature)
+        except VapiError as e:
+            return _err(400, str(e))
+        return web.Response(status=200)
+
+    # -- duties ------------------------------------------------------------
+
+    def _resolve_pubkey_by_index(self, vidx: int) -> PubKey:
+        pk = self._pubkey_by_index.get(vidx)
+        if pk is None:
+            raise VapiError(f"unknown validator index {vidx}")
+        return pk
+
+    async def _attester_duties(self, request: web.Request) -> web.Response:
+        if self.beacon is None:
+            return _err(404, "no beacon client")
+        epoch = int(request.match_info["epoch"])
+        try:
+            want = {int(i) for i in await request.json()}
+        except (json.JSONDecodeError, ValueError, TypeError):
+            want = set(self.validators.values())
+        duties = await self.beacon.attester_duties(epoch, self.validators)
+        out = [
+            {
+                "pubkey": d["pubkey"],
+                "validator_index": str(d["validator_index"]),
+                "committee_index": str(d["committee_index"]),
+                "committee_length": str(d["committee_length"]),
+                "committees_at_slot": str(d["committees_at_slot"]),
+                "validator_committee_index": str(d["validator_committee_index"]),
+                "slot": str(d["slot"]),
+            }
+            for d in duties
+            if d["validator_index"] in want
+        ]
+        return web.json_response(
+            {"dependent_root": _hex(bytes(32)), "data": out}
+        )
+
+    async def _proposer_duties(self, request: web.Request) -> web.Response:
+        if self.beacon is None:
+            return _err(404, "no beacon client")
+        epoch = int(request.match_info["epoch"])
+        duties = await self.beacon.proposer_duties(epoch, self.validators)
+        out = [
+            {
+                "pubkey": d["pubkey"],
+                "validator_index": str(d["validator_index"]),
+                "slot": str(d["slot"]),
+            }
+            for d in duties
+        ]
+        return web.json_response(
+            {"dependent_root": _hex(bytes(32)), "data": out}
+        )
+
+    async def _sync_duties(self, request: web.Request) -> web.Response:
+        if self.beacon is None:
+            return _err(404, "no beacon client")
+        epoch = int(request.match_info["epoch"])
+        try:
+            want = {int(i) for i in await request.json()}
+        except (json.JSONDecodeError, ValueError, TypeError):
+            want = set(self.validators.values())
+        duties = await self.beacon.sync_duties(epoch, self.validators)
+        # sync_committee_index // 128 must equal the subcommittee_index the
+        # scheduler keys contributions on, or the VC's contribution query
+        # never matches the stored duty
+        out = [
+            {
+                "pubkey": d["pubkey"],
+                "validator_index": str(d["validator_index"]),
+                "validator_sync_committee_indices": [
+                    str(d.get("subcommittee_index", 0) * 128)
+                ],
+            }
+            for d in duties
+            if d["validator_index"] in want
+        ]
+        return web.json_response({"data": out})
+
+    # -- validators mapping ------------------------------------------------
+
+    def _validator_json(self, pubkey_hex: str, vidx: int) -> dict:
+        return {
+            "index": str(vidx),
+            "balance": "32000000000",
+            "status": "active_ongoing",
+            "validator": {
+                "pubkey": pubkey_hex,
+                "withdrawal_credentials": _hex(bytes(32)),
+                "effective_balance": "32000000000",
+                "slashed": False,
+                "activation_eligibility_epoch": "0",
+                "activation_epoch": "0",
+                "exit_epoch": "18446744073709551615",
+                "withdrawable_epoch": "18446744073709551615",
+            },
+        }
+
+    async def _get_validators(self, request: web.Request) -> web.Response:
+        """Serves cluster validators; querying by this node's pubshare
+        returns the entry with the pubshare as pubkey so an unmodified VC
+        sees "its" keys as active (ref: validatorapi.go:1080,1167)."""
+        ids: list[str] = []
+        if request.method == "POST":
+            try:
+                j = await request.json()
+                ids = list(j.get("ids", []))
+            except (json.JSONDecodeError, AttributeError):
+                ids = []
+        else:
+            # beacon API sends repeated ?id=...&id=... keys; comma-separated
+            # values inside each are also accepted
+            ids = [
+                part
+                for raw in request.query.getall("id", [])
+                for part in raw.split(",")
+                if part
+            ]
+        out = []
+        if not ids:
+            for pk, vidx in sorted(self.validators.items()):
+                out.append(self._validator_json(pk, vidx))
+        else:
+            for ident in ids:
+                ident = ident.lower()
+                group = self._resolve_pubkey(ident) if ident.startswith("0x") else None
+                if group is not None and group in self.validators:
+                    out.append(
+                        self._validator_json(ident, self.validators[group])
+                    )
+                elif ident.isdigit():
+                    try:
+                        pk = self._resolve_pubkey_by_index(int(ident))
+                        out.append(self._validator_json(pk, int(ident)))
+                    except VapiError:
+                        pass
+        return web.json_response({"data": out})
+
+    async def _get_validator(self, request: web.Request) -> web.Response:
+        ident = request.match_info["validator_id"].lower()
+        if ident.startswith("0x"):
+            group = self._resolve_pubkey(ident)
+            if group in self.validators:
+                return web.json_response(
+                    {"data": self._validator_json(ident, self.validators[group])}
+                )
+        elif ident.isdigit():
+            try:
+                pk = self._resolve_pubkey_by_index(int(ident))
+                return web.json_response(
+                    {"data": self._validator_json(pk, int(ident))}
+                )
+            except VapiError:
+                pass
+        return _err(404, f"validator {ident} not found")
+
+    async def _head_root(self, request: web.Request) -> web.Response:
+        """Cluster-agreed head root for sync-committee signing. `slot` may
+        be passed to select the SYNC_MESSAGE duty (defaults to the current
+        slot by genesis arithmetic)."""
+        try:
+            if "slot" in request.query:
+                slot = int(request.query["slot"])
+            else:
+                import time as _t
+
+                slot = self.clock.slot_at(_t.time())
+        except ValueError:
+            return _err(400, "bad slot")
+        defs = (
+            self.vapi._duty_defs(Duty(slot, DutyType.SYNC_MESSAGE))
+            if self.vapi._duty_defs
+            else {}
+        )
+        if not defs:
+            return _err(404, f"no sync duty at slot {slot}")
+        duty = await self.vapi.sync_message_duty(slot, next(iter(defs)))
+        return web.json_response(
+            {"data": {"root": _hex(duty.beacon_block_root)}}
+        )
+
+    # -- metadata ----------------------------------------------------------
+
+    async def _ok(self, request: web.Request) -> web.Response:
         return web.Response(status=200)
 
     async def _node_version(self, request: web.Request) -> web.Response:
         from charon_tpu import __version__ as version
 
-        return web.json_response(
-            {"data": {"version": f"charon-tpu/{version}"}}
-        )
+        return web.json_response({"data": {"version": f"charon-tpu/{version}"}})
 
     async def _syncing(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -168,5 +839,63 @@ class VapiRouter:
                     "is_syncing": False,
                     "is_optimistic": False,
                 }
+            }
+        )
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.Response(status=200)
+
+    async def _genesis(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "data": {
+                    "genesis_time": str(int(self.genesis_time)),
+                    "genesis_validators_root": _hex(
+                        self.vapi.fork.genesis_validators_root
+                    ),
+                    "genesis_fork_version": _hex(
+                        self.vapi.fork.genesis_fork_version
+                    ),
+                }
+            }
+        )
+
+    async def _spec(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "data": {
+                    "SECONDS_PER_SLOT": str(int(self.slot_duration) or 1),
+                    "SLOTS_PER_EPOCH": str(self.slots_per_epoch),
+                    "DOMAIN_BEACON_ATTESTER": "0x01000000",
+                    "DOMAIN_BEACON_PROPOSER": "0x00000000",
+                    "DOMAIN_RANDAO": "0x02000000",
+                }
+            }
+        )
+
+    async def _fork_schedule(self, request: web.Request) -> web.Response:
+        fv = _hex(self.vapi.fork.fork_version)
+        return web.json_response(
+            {
+                "data": [
+                    {
+                        "previous_version": fv,
+                        "current_version": fv,
+                        "epoch": "0",
+                    }
+                ]
+            }
+        )
+
+    async def _state_fork(self, request: web.Request) -> web.Response:
+        fv = _hex(self.vapi.fork.fork_version)
+        return web.json_response(
+            {
+                "data": {
+                    "previous_version": fv,
+                    "current_version": fv,
+                    "epoch": "0",
+                },
+                "execution_optimistic": False,
             }
         )

@@ -56,6 +56,7 @@ def wire(
     )
     validatorapi.register_await_sync_message(dutydb.await_sync_message)
     validatorapi.register_pubkey_by_attestation(dutydb.pubkey_by_attestation)
+    validatorapi.register_await_aggregated(aggsigdb.await_)
     validatorapi.register_get_duty_definition(scheduler.get_duty_definition)
     validatorapi.subscribe(wrap("parsigdb.store_internal", parsigdb.store_internal))
     parsigdb.subscribe_internal(wrap("parsigex.broadcast", parsigex.broadcast))

@@ -150,6 +150,36 @@ class FrostParticipant:
         if set(my_shares) != set(range(1, self.n + 1)):
             raise ValueError("missing round-1 shares")
 
+        # Structural validation before any verification math (ADVICE round
+        # 1): a wrong-length commitment vector would misalign the batched
+        # share verification below, and a degree >= t polynomial from a
+        # malicious peer would break the t-of-n threshold property.
+        from charon_tpu.crypto.g1g2 import g1_in_subgroup, g1_is_on_curve
+
+        for i, blist in broadcasts.items():
+            if len(blist) != self.v:
+                raise ValueError(
+                    f"peer {i}: {len(blist)} ceremonies, want {self.v}"
+                )
+            for v, b in enumerate(blist):
+                if len(b.commitments) != self.t:
+                    raise ValueError(
+                        f"peer {i} validator {v}: {len(b.commitments)} "
+                        f"commitments, want t={self.t}"
+                    )
+                for pt in (*b.commitments, b.pok_r):
+                    if pt is None or not (
+                        g1_is_on_curve(pt) and g1_in_subgroup(pt)
+                    ):
+                        raise ValueError(
+                            f"peer {i} validator {v}: commitment not in G1"
+                        )
+        for i, sh in my_shares.items():
+            if len(sh.shares) != self.v or not all(
+                isinstance(s, int) and 0 <= s < R for s in sh.shares
+            ):
+                raise ValueError(f"peer {i}: malformed share vector")
+
         self._verify_poks(broadcasts, engine)
         self._verify_shares(broadcasts, my_shares, engine)
 

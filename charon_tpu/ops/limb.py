@@ -415,13 +415,26 @@ FP32 = make_ctx("fp32", P, 32, limb_bits=12, np_dtype=np.uint32)
 FR32 = make_ctx("fr32", FR_MOD, 22, limb_bits=12, np_dtype=np.uint32)
 
 
+def _is_tpu_backend() -> bool:
+    """True when the default device is a TPU — including TPUs exposed via
+    alternative PJRT plugins whose platform name is not literally "tpu"
+    (e.g. tunneled plugins reporting device_kind "TPU v5 lite")."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        d = jax.devices()[0]
+        return "tpu" in f"{d.platform} {d.device_kind}".lower()
+    except Exception:
+        return False
+
+
 def default_fp_ctx() -> ModCtx:
     """Pick the Fp context matching the default JAX backend."""
-    return FP32 if jax.default_backend() == "tpu" else FP
+    return FP32 if _is_tpu_backend() else FP
 
 
 def default_fr_ctx() -> ModCtx:
-    return FR32 if jax.default_backend() == "tpu" else FR
+    return FR32 if _is_tpu_backend() else FR
 
 
 def pack_mont_host(ctx: ModCtx, values) -> np.ndarray:

@@ -112,6 +112,7 @@ def _register_core_types() -> None:
         d.SignedData,
         d.ParSignedData,
         d.SyncSelectionData,
+        d.SyncMessageDuty,
         qbft.Msg,
     ):
         register(cls)

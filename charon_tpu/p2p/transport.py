@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import hmac as hmac_mod
 import json
 import os
 import time
@@ -53,7 +54,45 @@ class _Conn:
     reader: asyncio.StreamReader
     writer: asyncio.StreamWriter
     peer_idx: int
+    # Per-connection MAC key from static-static ECDH + handshake nonces;
+    # frames carry a truncated HMAC over (direction, counter, body) so a
+    # relay or on-path attacker cannot inject or replay frames (ADVICE:
+    # the reference gets this from mutual libp2p-TLS, p2p/p2p.go).
+    mac_key: bytes = b""
+    send_dir: bytes = b"\x01"
+    recv_dir: bytes = b"\x02"
+    send_ctr: int = 0
+    recv_ctr: int = 0
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+
+_MAC_LEN = 16
+
+
+def _frame_mac(key: bytes, direction: bytes, ctr: int, body: bytes) -> bytes:
+    return hmac_mod.new(
+        key, direction + ctr.to_bytes(8, "big") + body, hashlib.sha256
+    ).digest()[:_MAC_LEN]
+
+
+def _write_sframe(conn: _Conn, body: bytes) -> None:
+    mac = _frame_mac(conn.mac_key, conn.send_dir, conn.send_ctr, body)
+    # Write first, then advance the counter: an oversized-frame ValueError
+    # must not desynchronize the MAC counters of a healthy connection.
+    _write_frame(conn.writer, mac + body)
+    conn.send_ctr += 1
+
+
+async def _read_sframe(conn: _Conn) -> bytes:
+    frame = await _read_frame(conn.reader)
+    if len(frame) < _MAC_LEN:
+        raise ConnectionError("short frame")
+    mac, body = frame[:_MAC_LEN], frame[_MAC_LEN:]
+    want = _frame_mac(conn.mac_key, conn.recv_dir, conn.recv_ctr, body)
+    if not hmac_mod.compare_digest(mac, want):
+        raise ConnectionError("bad frame mac")
+    conn.recv_ctr += 1
+    return body
 
 
 class P2PNode:
@@ -102,20 +141,49 @@ class P2PNode:
         self._handlers[protocol] = handler
 
     # -- handshake --------------------------------------------------------
+    #
+    # Mutual authentication (ADVICE round 1; ref gets this from libp2p-TLS
+    # with pinned peer identities, p2p/p2p.go):
+    #   1. responder sends nonce_s;
+    #   2. dialer sends {idx, nonce_c, sig over transcript(dialer_idx,
+    #      responder_idx, nonce_s, nonce_c)} — binding BOTH identities and
+    #      BOTH nonces, so the challenge cannot be relayed to a third peer;
+    #   3. responder verifies, replies {idx, sig over ack-transcript};
+    #      dialer verifies against the pubkey of the peer it dialed.
+    # Both sides then derive a per-connection MAC key from static-static
+    # ECDH + the nonces; every subsequent frame is HMAC'd with a direction
+    # byte and a monotonically increasing counter (no injection/replay).
 
-    def _hello_digest(self, idx: int, nonce: bytes) -> bytes:
+    def _transcript(self, tag: bytes, dialer: int, responder: int,
+                    nonce_s: bytes, nonce_c: bytes) -> bytes:
         return hashlib.sha256(
-            b"charon-tpu-hello" + self.cluster_hash + idx.to_bytes(4, "big") + nonce
+            tag
+            + self.cluster_hash
+            + dialer.to_bytes(4, "big")
+            + responder.to_bytes(4, "big")
+            + nonce_s
+            + nonce_c
+        ).digest()
+
+    def _session_key(self, peer_pubkey: bytes, dialer: int, responder: int,
+                     nonce_s: bytes, nonce_c: bytes) -> bytes:
+        shared = k1util.ecdh(self.key, peer_pubkey)
+        return hashlib.sha256(
+            b"charon-tpu-key-v2"
+            + self.cluster_hash
+            + shared
+            + dialer.to_bytes(4, "big")
+            + responder.to_bytes(4, "big")
+            + nonce_s
+            + nonce_c
         ).digest()
 
     async def _on_inbound(self, reader, writer) -> None:
         try:
-            nonce = os.urandom(16)
-            writer.write(nonce)
+            nonce_s = os.urandom(16)
+            writer.write(nonce_s)
             await writer.drain()
-            hello = await asyncio.wait_for(
-                _read_frame(reader), RECV_TIMEOUT
-            )
+            hello = await asyncio.wait_for(_read_frame(reader), RECV_TIMEOUT)
             h = json.loads(hello)
             idx = h["idx"]
             peer = self.peers.get(idx)
@@ -123,15 +191,33 @@ class P2PNode:
             # (ref: p2p/gater.go:16-77)
             if peer is None:
                 raise HandshakeError(f"unknown peer index {idx}")
+            nonce_c = bytes.fromhex(h["nonce"])
             sig = bytes.fromhex(h["sig"])
-            if not k1util.verify_bytes(
-                peer.pubkey, self._hello_digest(idx, nonce), sig
-            ):
+            digest = self._transcript(
+                b"charon-tpu-hello-v2", idx, self.index, nonce_s, nonce_c
+            )
+            if not k1util.verify_bytes(peer.pubkey, digest, sig):
                 raise HandshakeError(f"bad handshake signature from {idx}")
-        except (HandshakeError, Exception):
+            ack = self._transcript(
+                b"charon-tpu-ack-v2", idx, self.index, nonce_s, nonce_c
+            )
+            _write_frame(
+                writer,
+                json.dumps(
+                    {"idx": self.index, "sig": k1util.sign(self.key, ack).hex()}
+                ).encode(),
+            )
+            await writer.drain()
+            key = self._session_key(
+                peer.pubkey, idx, self.index, nonce_s, nonce_c
+            )
+        except Exception:
             writer.close()
             return
-        conn = _Conn(reader, writer, idx)
+        conn = _Conn(
+            reader, writer, idx,
+            mac_key=key, send_dir=b"\x02", recv_dir=b"\x01",
+        )
         self._conns.setdefault(idx, conn)
         self._spawn_recv(conn)
 
@@ -139,14 +225,39 @@ class P2PNode:
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(peer.host, peer.port), SEND_TIMEOUT
         )
-        nonce = await asyncio.wait_for(reader.readexactly(16), RECV_TIMEOUT)
-        sig = k1util.sign(self.key, self._hello_digest(self.index, nonce))
+        nonce_s = await asyncio.wait_for(reader.readexactly(16), RECV_TIMEOUT)
+        nonce_c = os.urandom(16)
+        digest = self._transcript(
+            b"charon-tpu-hello-v2", self.index, peer.index, nonce_s, nonce_c
+        )
         _write_frame(
             writer,
-            json.dumps({"idx": self.index, "sig": sig.hex()}).encode(),
+            json.dumps(
+                {
+                    "idx": self.index,
+                    "nonce": nonce_c.hex(),
+                    "sig": k1util.sign(self.key, digest).hex(),
+                }
+            ).encode(),
         )
         await writer.drain()
-        conn = _Conn(reader, writer, peer.index)
+        ack_frame = await asyncio.wait_for(_read_frame(reader), RECV_TIMEOUT)
+        a = json.loads(ack_frame)
+        ack = self._transcript(
+            b"charon-tpu-ack-v2", self.index, peer.index, nonce_s, nonce_c
+        )
+        if a.get("idx") != peer.index or not k1util.verify_bytes(
+            peer.pubkey, ack, bytes.fromhex(a["sig"])
+        ):
+            writer.close()
+            raise HandshakeError(f"responder {peer.index} failed mutual auth")
+        key = self._session_key(
+            peer.pubkey, self.index, peer.index, nonce_s, nonce_c
+        )
+        conn = _Conn(
+            reader, writer, peer.index,
+            mac_key=key, send_dir=b"\x01", recv_dir=b"\x02",
+        )
         self._spawn_recv(conn)
         return conn
 
@@ -168,7 +279,6 @@ class P2PNode:
             "p": protocol,
             "id": req_id,
             "k": "req",
-            "s": self.index,
             "d": codec._to_jsonable(msg) if msg is not None else None,
         }
         fut = None
@@ -178,7 +288,7 @@ class P2PNode:
         try:
             conn = await self._get_conn(peer_idx)
             async with conn.lock:
-                _write_frame(conn.writer, json.dumps(envelope).encode())
+                _write_sframe(conn, json.dumps(envelope).encode())
                 await asyncio.wait_for(conn.writer.drain(), SEND_TIMEOUT)
             self._fail_counts[peer_idx] = 0
             if fut is not None:
@@ -196,7 +306,10 @@ class P2PNode:
         return self._fail_counts.get(peer_idx, 0) >= HYSTERESIS_FAILS
 
     async def broadcast(self, protocol: str, msg) -> None:
-        """Fire-and-forget to every peer; failures are independent."""
+        """Fire-and-forget to every peer; failures are independent.
+        Network errors surface via hysteresis state; programming errors
+        (unserializable payloads) are logged loudly — silently dropping
+        every frame would stall consensus with healthy-looking pings."""
         results = await asyncio.gather(
             *(
                 self.send(idx, protocol, msg)
@@ -204,7 +317,17 @@ class P2PNode:
             ),
             return_exceptions=True,
         )
-        del results  # individual failures surface via hysteresis state
+        for res in results:
+            if isinstance(res, (TypeError, ValueError)):
+                from charon_tpu.app import log
+
+                log.error(
+                    "broadcast payload error",
+                    topic="p2p",
+                    protocol=protocol,
+                    error=repr(res),
+                )
+                break
 
     # -- receive ----------------------------------------------------------
 
@@ -216,7 +339,7 @@ class P2PNode:
     async def _recv_loop(self, conn: _Conn) -> None:
         try:
             while True:
-                frame = await _read_frame(conn.reader)
+                frame = await _read_sframe(conn)
                 env = json.loads(frame)
                 if env["k"] == "rsp":
                     fut = self._pending.pop(env["id"], None)
@@ -227,7 +350,10 @@ class P2PNode:
                 if handler is None:
                     continue
                 msg = codec._from_jsonable(env["d"]) if env["d"] is not None else None
-                resp = await handler(env.get("s", conn.peer_idx), msg)
+                # Source = the connection's authenticated peer index; a
+                # sender-claimed envelope field would allow impersonation
+                # (ADVICE round 1).
+                resp = await handler(conn.peer_idx, msg)
                 if resp is not None:
                     out = {
                         "p": env["p"],
@@ -236,7 +362,7 @@ class P2PNode:
                         "d": codec._to_jsonable(resp),
                     }
                     async with conn.lock:
-                        _write_frame(conn.writer, json.dumps(out).encode())
+                        _write_sframe(conn, json.dumps(out).encode())
                         await conn.writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass

@@ -45,6 +45,9 @@ class BeaconMock:
         self.contributions: list = []
         # test override hooks (ref: beaconmock/options.go pattern)
         self.attestation_data_fn = self._attestation_data_default
+        # att-data roots served, so aggregate_attestation can look up the
+        # exact data the root refers to
+        self._att_data_by_root: dict[bytes, AttestationData] = {}
 
     # -- chain metadata ---------------------------------------------------
 
@@ -89,6 +92,19 @@ class BeaconMock:
             out.append(dict(slot=slot, pubkey=pubkey, validator_index=vidx))
         return out
 
+    async def sync_duties(self, epoch: int, validators: dict[PubKey, int]):
+        """Every validator is a sync-committee member (deterministic);
+        subcommittee = validator index mod 4 (ref: beaconmock
+        WithDeterministicSyncCommDuties)."""
+        return [
+            dict(
+                pubkey=pubkey,
+                validator_index=vidx,
+                subcommittee_index=vidx % 4,
+            )
+            for pubkey, vidx in sorted(validators.items())
+        ]
+
     # -- duty data --------------------------------------------------------
 
     def _root(self, *parts) -> bytes:
@@ -108,7 +124,9 @@ class BeaconMock:
         )
 
     async def attestation_data(self, slot: int, committee_index: int) -> AttestationData:
-        return self.attestation_data_fn(slot, committee_index)
+        data = self.attestation_data_fn(slot, committee_index)
+        self._att_data_by_root[data.hash_tree_root()] = data
+        return data
 
     async def block_proposal(self, slot: int, proposer_index: int, randao: bytes) -> Proposal:
         body = b"mock-body:" + randao[:8]
@@ -128,7 +146,9 @@ class BeaconMock:
         pool attestations; deterministic here)."""
         from charon_tpu.core.eth2data import Attestation
 
-        data = self.attestation_data_fn(slot, 0)
+        data = self._att_data_by_root.get(att_data_root)
+        if data is None:
+            data = self.attestation_data_fn(slot, 0)
         return Attestation(
             aggregation_bits=(True, True), data=data
         )

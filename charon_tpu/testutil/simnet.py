@@ -69,6 +69,7 @@ def build_cluster(
     slots_per_epoch: int = 8,
     genesis_time: float | None = None,
     use_qbft: bool = False,
+    wire_vmock: bool = True,
 ) -> SimCluster:
     """Create keys and wire n in-process nodes (ref: app/app.go simnet +
     cluster/test_cluster.go generator, redesigned for asyncio)."""
@@ -118,7 +119,9 @@ def build_cluster(
         qbft_net = MemMsgNet()
     for i in range(1, n + 1):
         cluster.nodes.append(
-            _build_node(cluster, i, transport, slots_per_epoch, qbft_net)
+            _build_node(
+                cluster, i, transport, slots_per_epoch, qbft_net, wire_vmock
+            )
         )
     return cluster
 
@@ -129,6 +132,7 @@ def _build_node(
     transport: MemTransport,
     spe: int,
     qbft_net=None,
+    wire_vmock: bool = True,
 ) -> SimNode:
     beacon = cluster.beacon
     fork = cluster.fork
@@ -200,6 +204,7 @@ def _build_node(
 
     # The vmock performs duties when the scheduler triggers them
     # (ref: app/vmock.go wires validatormock to scheduler duties).
+    # wire_vmock=False lets tests drive duties over HTTP instead.
     async def on_duty(duty, defs):
         from charon_tpu.core.types import DutyType
 
@@ -211,7 +216,8 @@ def _build_node(
             for pubkey in defs:
                 asyncio.create_task(vmock.propose(duty.slot, pubkey))
 
-    scheduler.subscribe_duties(on_duty)
+    if wire_vmock:
+        scheduler.subscribe_duties(on_duty)
 
     return SimNode(
         share_idx=share_idx,
