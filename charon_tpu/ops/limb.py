@@ -304,25 +304,48 @@ def const(ctx: ModCtx, value: int, batch_shape=()):
 # ---------------------------------------------------------------------------
 
 
-def _conv_full(ctx: ModCtx, a, b):
-    """Schoolbook product into 2n columns. Column sums stay within the
-    accumulator headroom (asserted in make_ctx), so no mid-loop carries."""
-    n = ctx.n_limbs
-    outer = a[..., :, None] * b[..., None, :]  # (..., n, n)
-    t = jnp.zeros(a.shape[:-1] + (2 * n,), ctx.dtype)
+@functools.lru_cache(maxsize=None)
+def _band_index(n: int, out_cols: int):
+    """idx[i, k] = k - i clipped to [0, n-1], valid[i, k] = 0 <= k-i < n.
+
+    Used to express the schoolbook product as ONE gather + ONE contraction
+    instead of n scatter-adds: t[..., k] = sum_i a_i * b_{k-i}. Keeping the
+    hot multiply at ~3 ops (vs ~n dynamic-update-slices) is what makes the
+    pairing kernel's scan body compilable in seconds instead of minutes on
+    TPU (XLA optimization time scales with scan-body op count)."""
+    idx = np.zeros((n, out_cols), np.int32)
+    valid = np.zeros((n, out_cols), bool)
     for i in range(n):
-        t = t.at[..., i : i + n].add(outer[..., i, :])
-    return t
+        for k in range(out_cols):
+            j = k - i
+            if 0 <= j < n:
+                idx[i, k] = j
+                valid[i, k] = True
+    return idx, valid
+
+
+def _conv(ctx: ModCtx, a, b, out_cols: int):
+    """Banded product t[..., k] = sum_{i+j=k} a_i * b_j over out_cols
+    columns. Column sums stay within the accumulator headroom (asserted in
+    make_ctx), so no mid-loop carries."""
+    n = ctx.n_limbs
+    idx, valid = _band_index(n, out_cols)
+    # b_shift[..., i, k] = b[..., k-i] (zero outside the band)
+    b_shift = jnp.where(
+        jnp.asarray(valid), b[..., jnp.asarray(idx)], ctx.u(0)
+    )
+    # contraction over the limb axis i: (..., i) x (..., i, k) -> (..., k)
+    return jnp.einsum("...i,...ik->...k", a, b_shift)
+
+
+def _conv_full(ctx: ModCtx, a, b):
+    """Schoolbook product into 2n columns."""
+    return _conv(ctx, a, b, 2 * ctx.n_limbs)
 
 
 def _conv_low(ctx: ModCtx, a, b):
     """Low n columns of the product (mod 2^(limb_bits*n))."""
-    n = ctx.n_limbs
-    outer = a[..., :, None] * b[..., None, :]
-    t = jnp.zeros(a.shape[:-1] + (n,), ctx.dtype)
-    for i in range(n):
-        t = t.at[..., i:].add(outer[..., i, : n - i])
-    return t
+    return _conv(ctx, a, b, ctx.n_limbs)
 
 
 def mont_mul(ctx: ModCtx, a, b):

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import hmac as hmac_mod
 import json
 import os
 import time
@@ -54,10 +53,12 @@ class _Conn:
     reader: asyncio.StreamReader
     writer: asyncio.StreamWriter
     peer_idx: int
-    # Per-connection MAC key from static-static ECDH + handshake nonces;
-    # frames carry a truncated HMAC over (direction, counter, body) so a
-    # relay or on-path attacker cannot inject or replay frames (ADVICE:
-    # the reference gets this from mutual libp2p-TLS, p2p/p2p.go).
+    # Per-connection AES-GCM key from static-static ECDH + handshake
+    # nonces; every frame is sealed (confidentiality + integrity) with a
+    # (direction, counter) nonce so a relay or on-path attacker can
+    # neither read, inject, reorder, nor replay frames. Confidentiality
+    # matters because DKG secret shares ride this channel (the reference
+    # gets both properties from mutual libp2p-TLS, p2p/p2p.go).
     mac_key: bytes = b""
     send_dir: bytes = b"\x01"
     recv_dir: bytes = b"\x02"
@@ -65,32 +66,34 @@ class _Conn:
     recv_ctr: int = 0
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
+    def _aead(self):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
-_MAC_LEN = 16
+        return AESGCM(self.mac_key)
 
 
-def _frame_mac(key: bytes, direction: bytes, ctr: int, body: bytes) -> bytes:
-    return hmac_mod.new(
-        key, direction + ctr.to_bytes(8, "big") + body, hashlib.sha256
-    ).digest()[:_MAC_LEN]
+def _nonce(direction: bytes, ctr: int) -> bytes:
+    return direction * 4 + ctr.to_bytes(8, "big")  # 12 bytes
 
 
 def _write_sframe(conn: _Conn, body: bytes) -> None:
-    mac = _frame_mac(conn.mac_key, conn.send_dir, conn.send_ctr, body)
+    sealed = conn._aead().encrypt(
+        _nonce(conn.send_dir, conn.send_ctr), body, None
+    )
     # Write first, then advance the counter: an oversized-frame ValueError
-    # must not desynchronize the MAC counters of a healthy connection.
-    _write_frame(conn.writer, mac + body)
+    # must not desynchronize the nonce counters of a healthy connection.
+    _write_frame(conn.writer, sealed)
     conn.send_ctr += 1
 
 
 async def _read_sframe(conn: _Conn) -> bytes:
     frame = await _read_frame(conn.reader)
-    if len(frame) < _MAC_LEN:
-        raise ConnectionError("short frame")
-    mac, body = frame[:_MAC_LEN], frame[_MAC_LEN:]
-    want = _frame_mac(conn.mac_key, conn.recv_dir, conn.recv_ctr, body)
-    if not hmac_mod.compare_digest(mac, want):
-        raise ConnectionError("bad frame mac")
+    try:
+        body = conn._aead().decrypt(
+            _nonce(conn.recv_dir, conn.recv_ctr), frame, None
+        )
+    except Exception as e:
+        raise ConnectionError(f"frame decryption failed: {e}") from e
     conn.recv_ctr += 1
     return body
 

@@ -64,7 +64,7 @@ class SlotCryptoPlane:
         ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
         g2f = C.g2_ops(ctx)
 
-        def local_step(pubshares, msg, partials, group_pk, indices):
+        def local_step(pubshares, msg, partials, group_pk, indices, live):
             # [Vl, t] partial verifies: flatten share axis into the batch.
             flat = jax.tree_util.tree_map(
                 lambda a: a.reshape(-1, *a.shape[2:]), (pubshares, partials)
@@ -87,13 +87,16 @@ class SlotCryptoPlane:
             group_ok = DP.batched_verify(ctx, group_pk, msg, group_sig)
 
             ok = jnp.logical_and(jnp.all(part_ok, axis=-1), group_ok)
+            # `live` masks padding lanes (V rounded up to the mesh size)
+            # out of the cluster-wide count
+            ok = jnp.logical_and(ok, live)
             total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis)
             return group_sig, ok, total
 
         sharded = jax.shard_map(
             local_step,
             mesh=self.mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
             out_specs=(P(axis), P(axis), P()),
         )
         return jax.jit(sharded)
@@ -106,33 +109,44 @@ class SlotCryptoPlane:
     def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):
         """Python-int affine points -> device arrays laid out [V, t]/[V].
 
-        V must be divisible by the mesh size (callers pad with identity
-        lanes; identity lanes verify as False and are sliced off).
-        """
+        V that is not divisible by the mesh size is padded up by repeating
+        lane 0; padding lanes carry live=False and are excluded from the
+        psum total (and sliced off by step_host)."""
         v = len(msgs)
         t = self.t
+        shards = self.shard_count()
+        pad = (-v) % shards
+        if pad:
+            pubshares = list(pubshares) + [pubshares[0]] * pad
+            msgs = list(msgs) + [msgs[0]] * pad
+            partials = list(partials) + [partials[0]] * pad
+            group_pks = list(group_pks) + [group_pks[0]] * pad
+            indices = list(indices) + [indices[0]] * pad
+        vp = v + pad
         flat_ps = [p for row in pubshares for p in row]
         flat_sig = [s for row in partials for s in row]
         ps = C.g1_pack(self.ctx, flat_ps)
-        ps = jax.tree_util.tree_map(lambda a: a.reshape(v, t, -1), ps)
+        ps = jax.tree_util.tree_map(lambda a: a.reshape(vp, t, -1), ps)
         sig = C.g2_pack(self.ctx, flat_sig)
-        sig = jax.tree_util.tree_map(lambda a: a.reshape(v, t, -1), sig)
+        sig = jax.tree_util.tree_map(lambda a: a.reshape(vp, t, -1), sig)
         msg = C.g2_pack(self.ctx, msgs)
         gpk = C.g1_pack(self.ctx, group_pks)
         idx = jnp.asarray(np.asarray(indices, np.int32))
-        return ps, msg, sig, gpk, idx
+        live = jnp.asarray(np.arange(vp) < v)
+        return ps, msg, sig, gpk, idx, live
 
-    def step(self, pubshares, msg, partials, group_pk, indices):
+    def step(self, pubshares, msg, partials, group_pk, indices, live):
         """Run one slot step on packed inputs. Returns (group_sig, ok,
         total_ok) device values."""
-        return self._step(pubshares, msg, partials, group_pk, indices)
+        return self._step(pubshares, msg, partials, group_pk, indices, live)
 
     def step_host(self, pubshares, msgs, partials, group_pks, indices):
         """Convenience host-level wrapper (pack, run, unpack)."""
+        v = len(msgs)
         args = self.pack_inputs(pubshares, msgs, partials, group_pks, indices)
         group_sig, ok, total = self._step(*args)
         return (
-            C.g2_unpack(self.ctx, group_sig),
-            [bool(b) for b in np.asarray(ok)],
+            C.g2_unpack(self.ctx, group_sig)[:v],
+            [bool(b) for b in np.asarray(ok)[:v]],
             int(total),
         )
