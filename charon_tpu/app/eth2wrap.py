@@ -23,9 +23,18 @@ _METHODS = (
     "await_synced",
     "attester_duties",
     "proposer_duties",
+    "sync_duties",
     "attestation_data",
+    "aggregate_attestation",
     "block_proposal",
+    "sync_committee_block_root",
+    "sync_contribution",
+    "block_attestations",
+    "block_root",
     "submit_attestation",
+    "submit_aggregate",
+    "submit_sync_message",
+    "submit_contribution",
     "submit_proposal",
     "submit_registration",
     "submit_exit",

@@ -27,6 +27,7 @@ from charon_tpu.core.consensus_qbft import QBFTConsensus
 from charon_tpu.core.deadline import Deadliner, SlotClock
 from charon_tpu.core.dutydb import DutyDB
 from charon_tpu.core.fetcher import Fetcher
+from charon_tpu.core.inclusion import InclusionChecker, InclusionReport
 from charon_tpu.core.parsigdb import ParSigDB
 from charon_tpu.core.parsigex import DutyGater, Eth2Verifier, ParSigEx
 from charon_tpu.core.scheduler import Scheduler
@@ -76,6 +77,7 @@ class Node:
     tracker: Tracker
     metrics: ClusterMetrics
     beacon: object
+    inclusion: InclusionChecker | None = None
 
 
 async def build_node(config: Config) -> Node:
@@ -231,6 +233,14 @@ async def build_node(config: Config) -> Node:
     )
     scheduler.subscribe_duties(_register_deadline(deadliner))
 
+    # inclusion checker: broadcast duties must land on-chain within 32
+    # slots (ref: core/tracker/inclusion.go, wiring app/app.go:746-780)
+    inclusion = None
+    if hasattr(beacon, "block_attestations"):
+        inclusion = InclusionChecker(beacon, on_report=_log_inclusion)
+        bcast.subscribe(inclusion.submitted)
+        scheduler.subscribe_slots(inclusion.on_slot)
+
     vapi_router = VapiRouter(
         vapi,
         beacon=beacon,
@@ -282,7 +292,24 @@ async def build_node(config: Config) -> Node:
         tracker=tracker,
         metrics=metrics,
         beacon=beacon,
+        inclusion=inclusion,
     )
+
+
+def _log_inclusion(report: InclusionReport) -> None:
+    if report.included:
+        log.debug(
+            "duty included on-chain",
+            topic="inclusion",
+            duty=str(report.duty),
+            delay_slots=report.delay_slots,
+        )
+    else:
+        log.warn(
+            "duty missed on-chain inclusion",
+            topic="inclusion",
+            duty=str(report.duty),
+        )
 
 
 def _make_expiry(dutydb, parsigdb, aggsigdb, tracker, consensus=None):

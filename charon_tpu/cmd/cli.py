@@ -57,7 +57,50 @@ def build_parser() -> argparse.ArgumentParser:
     dkgp = sub.add_parser("dkg", help="run the distributed key generation ceremony")
     dkgp.add_argument("--definition-file", required=True)
     dkgp.add_argument("--data-dir", required=True)
-    dkgp.add_argument("--node-index", type=int, required=True)
+    dkgp.add_argument(
+        "--node-index",
+        type=int,
+        default=-1,
+        help="operator index; default: derived from this node's key",
+    )
+    dkgp.add_argument(
+        "--peers",
+        required=True,
+        help="comma-separated host:port per operator (index order)",
+    )
+    dkgp.add_argument("--timeout", type=float, default=120.0)
+    dkgp.add_argument("--no-tpu", action="store_true")
+
+    cenr = sub.add_parser(
+        "create-enr",
+        help="generate this node's p2p identity key and print its record",
+    )
+    cenr.add_argument("--data-dir", default=".charon")
+
+    cdkg = sub.add_parser(
+        "create-dkg",
+        help="generate an unsigned cluster-definition.json for a ceremony",
+    )
+    cdkg.add_argument("--name", default="charon-tpu-cluster")
+    cdkg.add_argument("--num-validators", type=int, default=1)
+    cdkg.add_argument(
+        "--threshold",
+        type=int,
+        default=0,
+        help="0 = BFT default n - floor((n-1)/3)",
+    )
+    cdkg.add_argument("--fork-version", default="0x00000000")
+    cdkg.add_argument(
+        "--operator-enrs", required=True, help="comma-separated operator records"
+    )
+    cdkg.add_argument("--output", default="cluster-definition.json")
+
+    sdef = sub.add_parser(
+        "sign-definition",
+        help="add this operator's signatures to a cluster definition",
+    )
+    sdef.add_argument("--definition-file", required=True)
+    sdef.add_argument("--data-dir", default=".charon")
 
     enrp = sub.add_parser("enr", help="print this node's identity record")
     enrp.add_argument("--data-dir", default=".charon")
@@ -148,15 +191,139 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _load_node_key(data_dir):
+    from charon_tpu.app import k1util
+
+    key_path = Path(data_dir) / "charon-enr-private-key"
+    return k1util.private_key_from_bytes(key_path.read_bytes())
+
+
+def _operator_index_for_key(defn, key) -> int:
+    """This key's 0-based operator index in the definition, or -1."""
+    from charon_tpu.app import k1util
+
+    my_pub = k1util.public_key_to_bytes(key.public_key()).hex()
+    for i, op in enumerate(defn.operators):
+        if op.enr.split(":")[-1] == my_pub:
+            return i
+    return -1
+
+
 def cmd_dkg(args) -> int:
-    # The multi-process TCP DKG transport lands with the networked
-    # ceremony; single-process ceremonies use create-cluster.
-    print(
-        "networked dkg not yet wired to TCP transports; "
-        "use create-cluster for local ceremonies",
-        file=sys.stderr,
+    """Networked ceremony over localhost/TCP (ref: dkg/dkg.go:82 Run):
+    mesh up -> sync protocol -> FROST -> signed lock + keystores written
+    to --data-dir."""
+    from charon_tpu.app import k1util
+    from charon_tpu.cluster.definition import ClusterDefinition
+    from charon_tpu.dkg.netdkg import run_networked_dkg
+
+    defn = ClusterDefinition.from_json(
+        json.loads(Path(args.definition_file).read_text())
     )
-    return 1
+    key = _load_node_key(args.data_dir)
+    node_idx = args.node_index
+    if node_idx < 0:
+        node_idx = _operator_index_for_key(defn, key)
+        if node_idx < 0:
+            print("this node's key matches no definition operator", file=sys.stderr)
+            return 1
+
+    peer_addrs = []
+    for part in args.peers.split(","):
+        host, port = part.rsplit(":", 1)
+        peer_addrs.append((host, int(port)))
+    if len(peer_addrs) != len(defn.operators):
+        print(
+            f"--peers must list all {len(defn.operators)} operators",
+            file=sys.stderr,
+        )
+        return 1
+
+    engine = None
+    if not args.no_tpu:
+        try:
+            from charon_tpu.ops import blsops, limb
+
+            engine = blsops.BlsEngine(
+                limb.default_fp_ctx(), limb.default_fr_ctx()
+            )
+        except Exception:
+            engine = None  # host fallback
+
+    result = asyncio.run(
+        run_networked_dkg(
+            defn,
+            node_idx,
+            key,
+            peer_addrs,
+            data_dir=args.data_dir,
+            engine=engine,
+            timeout=args.timeout,
+        )
+    )
+    print(f"dkg complete; lock hash: 0x{result.lock.lock_hash().hex()}")
+    return 0
+
+
+def cmd_create_enr(args) -> int:
+    """ref: cmd/createenr.go — new key + printed record."""
+    from charon_tpu.app import k1util
+
+    data_dir = Path(args.data_dir)
+    data_dir.mkdir(parents=True, exist_ok=True)
+    key_path = data_dir / "charon-enr-private-key"
+    if key_path.exists():
+        print(f"refusing to overwrite {key_path}", file=sys.stderr)
+        return 1
+    key = k1util.generate_private_key()
+    key_path.write_bytes(k1util.private_key_to_bytes(key))
+    print("enr:" + k1util.public_key_to_bytes(key.public_key()).hex())
+    return 0
+
+
+def cmd_create_dkg(args) -> int:
+    """ref: cmd/createdkg.go — an unsigned definition the operators then
+    sign (sign-definition) before running `dkg`."""
+    from charon_tpu.cluster.definition import ClusterDefinition, Operator
+
+    enrs = [e.strip() for e in args.operator_enrs.split(",") if e.strip()]
+    n = len(enrs)
+    if n < 3:
+        print("need at least 3 operators", file=sys.stderr)
+        return 1
+    threshold = args.threshold or n - (n - 1) // 3
+    defn = ClusterDefinition(
+        name=args.name,
+        num_validators=args.num_validators,
+        threshold=threshold,
+        fork_version=args.fork_version,
+        operators=tuple(
+            Operator(address=f"operator-{i}", enr=enr)
+            for i, enr in enumerate(enrs)
+        ),
+    )
+    Path(args.output).write_text(json.dumps(defn.to_json(), indent=2))
+    print(f"wrote {args.output} ({n} operators, threshold {threshold})")
+    return 0
+
+
+def cmd_sign_definition(args) -> int:
+    """Each operator signs the config hash + their record in turn
+    (ref: the launchpad EIP-712 signing step, cluster/eip712sigs.go)."""
+    from charon_tpu.app import k1util
+    from charon_tpu.cluster.definition import ClusterDefinition
+
+    path = Path(args.definition_file)
+    defn = ClusterDefinition.from_json(json.loads(path.read_text()))
+    key = _load_node_key(args.data_dir)
+    idx = _operator_index_for_key(defn, key)
+    if idx < 0:
+        print("this node's key matches no definition operator", file=sys.stderr)
+        return 1
+    defn = defn.sign_operator(idx, key)
+    path.write_text(json.dumps(defn.to_json(), indent=2))
+    print(f"signed as operator {idx}")
+    return 0
 
 
 def cmd_enr(args) -> int:
@@ -179,6 +346,9 @@ def main(argv=None) -> int:
         "run": cmd_run,
         "create-cluster": cmd_create_cluster,
         "dkg": cmd_dkg,
+        "create-enr": cmd_create_enr,
+        "create-dkg": cmd_create_dkg,
+        "sign-definition": cmd_sign_definition,
         "enr": cmd_enr,
     }[args.command](args)
 

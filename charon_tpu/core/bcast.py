@@ -23,6 +23,13 @@ class Broadcaster:
         self.broadcast_total: dict[DutyType, int] = {}
         self.broadcast_delay: list[tuple[Duty, float]] = []
         self._registrations: dict[Duty, dict] = {}
+        self._subs: list = []  # post-broadcast hooks (inclusion checker)
+
+    def subscribe(self, sub) -> None:
+        """Called with (duty, data_set) after a successful broadcast
+        (ref: the inclusion checker subscribes downstream of bcast,
+        app/app.go:746-780)."""
+        self._subs.append(sub)
 
     async def broadcast(self, duty: Duty, data_set: dict[PubKey, SignedData]) -> None:
         """ref: core/bcast/bcast.go:42 Broadcast type-switch."""
@@ -64,6 +71,8 @@ class Broadcaster:
             self.broadcast_delay.append(
                 (duty, time.time() - self.clock.slot_start(duty.slot))
             )
+        for sub in self._subs:
+            await sub(duty, data_set)
 
     def _with_sig(self, signed: SignedData):
         """Attestations carry their signature inline."""

@@ -1,0 +1,164 @@
+"""Inclusion checker: did broadcast duties actually land on-chain?
+
+Mirrors ref: core/tracker/inclusion.go — every submitted attestation,
+aggregate and block proposal is tracked; for the next INCL_CHECK_LAG slots
+the checker inspects each new block for the submission (attestation-data
+root + covered aggregation bits for attestations, the block root itself
+for proposals). Submissions found are reported included (with the
+inclusion delay); submissions still pending after the lag are reported
+missed. Wiring mirrors app/app.go:746-780: subscribes downstream of the
+broadcaster and on the scheduler's slot ticks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from charon_tpu.core.types import Duty, DutyType, PubKey
+
+# ref: core/tracker/inclusion.go InclCheckLag — a duty missing for 32
+# slots after submission is declared missed.
+INCL_CHECK_LAG = 32
+
+# Duty types the checker can observe on-chain. Everything else (randao,
+# selection proofs, exits, registrations) has no per-block footprint
+# (ref: inclusion.go only tracks attestations/aggregates/blocks).
+_TRACKED = (DutyType.ATTESTER, DutyType.AGGREGATOR, DutyType.PROPOSER)
+
+
+@dataclass(frozen=True)
+class InclusionReport:
+    duty: Duty
+    pubkey: PubKey
+    included: bool
+    delay_slots: int  # block slot - duty slot when included, else -1
+
+
+ReportSub = Callable[[InclusionReport], Awaitable[None] | None]
+
+
+@dataclass
+class _Pending:
+    duty: Duty
+    pubkey: PubKey
+    att_data_root: bytes | None  # attester/aggregator match key
+    agg_bits: tuple[bool, ...]  # bits our submission covered
+    block_root: bytes | None  # proposer match key
+
+
+class InclusionChecker:
+    """beacon duck-type requirements (provided by BeaconMock and the
+    production client): `block_attestations(slot) -> list | None` (None =
+    no block at that slot) and `block_root(slot) -> bytes | None`."""
+
+    def __init__(self, beacon, on_report: ReportSub | None = None) -> None:
+        self.beacon = beacon
+        self._pending: list[_Pending] = []
+        self._subs: list[ReportSub] = list(filter(None, [on_report]))
+        self._checked_until: int | None = None
+        self.included_total = 0
+        self.missed_total = 0
+        self.inclusion_delay_sum = 0
+
+    def subscribe(self, sub: ReportSub) -> None:
+        self._subs.append(sub)
+
+    # -- intake: wire after broadcaster.broadcast -------------------------
+
+    async def submitted(self, duty: Duty, data_set) -> None:
+        """Record broadcast signed duties (ref: inclusion.go Submitted)."""
+        if duty.type not in _TRACKED:
+            return
+        for pubkey, signed in data_set.items():
+            att_root = None
+            bits: tuple[bool, ...] = ()
+            block_root = None
+            payload = getattr(signed, "payload", signed)
+            if duty.type == DutyType.ATTESTER:
+                att_root = payload.data.hash_tree_root()
+                bits = tuple(payload.aggregation_bits)
+            elif duty.type == DutyType.AGGREGATOR:
+                # payload is an AggregateAndProof carrying .aggregate
+                agg = getattr(payload, "aggregate", payload)
+                att_root = agg.data.hash_tree_root()
+                bits = tuple(agg.aggregation_bits)
+            elif duty.type == DutyType.PROPOSER:
+                block_root = payload.hash_tree_root()
+            self._pending.append(
+                _Pending(
+                    duty=duty,
+                    pubkey=pubkey,
+                    att_data_root=att_root,
+                    agg_bits=bits,
+                    block_root=block_root,
+                )
+            )
+
+    # -- per-slot check: subscribe to scheduler slot ticks ----------------
+
+    async def on_slot(self, slot) -> None:
+        """Check blocks STRICTLY BEHIND the current slot (ref:
+        inclusion.go trails the head by a lag for the same reason): at
+        slot N's tick the slot-N duty has not broadcast yet, so block N
+        is only inspected at the N+1 tick, after its submissions exist.
+        Then expire submissions past the lag."""
+        current = slot.slot
+        start = self._checked_until
+        if start is None:
+            start = current - 2
+        for s in range(start + 1, current):
+            await self._check_block(s)
+        self._checked_until = current - 1
+
+        still = []
+        for p in self._pending:
+            if current - p.duty.slot > INCL_CHECK_LAG:
+                await self._report(
+                    InclusionReport(p.duty, p.pubkey, included=False, delay_slots=-1)
+                )
+                self.missed_total += 1
+            else:
+                still.append(p)
+        self._pending = still
+
+    async def _check_block(self, block_slot: int) -> None:
+        atts = await self.beacon.block_attestations(block_slot)
+        root = await self.beacon.block_root(block_slot)
+        if atts is None and root is None:
+            return  # no block this slot
+        by_root: dict[bytes, list] = {}
+        for att in atts or []:
+            by_root.setdefault(att.data.hash_tree_root(), []).append(att)
+
+        still = []
+        for p in self._pending:
+            hit = False
+            if p.att_data_root is not None:
+                for att in by_root.get(p.att_data_root, []):
+                    chain_bits = tuple(att.aggregation_bits)
+                    ours = tuple(p.agg_bits)
+                    if all(
+                        not mine or (i < len(chain_bits) and chain_bits[i])
+                        for i, mine in enumerate(ours)
+                    ):
+                        hit = True
+                        break
+            elif p.block_root is not None:
+                hit = block_slot == p.duty.slot and root == p.block_root
+            if hit:
+                delay = block_slot - p.duty.slot
+                self.included_total += 1
+                self.inclusion_delay_sum += delay
+                await self._report(
+                    InclusionReport(p.duty, p.pubkey, included=True, delay_slots=delay)
+                )
+            else:
+                still.append(p)
+        self._pending = still
+
+    async def _report(self, report: InclusionReport) -> None:
+        for sub in self._subs:
+            res = sub(report)
+            if hasattr(res, "__await__"):
+                await res

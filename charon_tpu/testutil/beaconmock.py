@@ -48,6 +48,13 @@ class BeaconMock:
         # att-data roots served, so aggregate_attestation can look up the
         # exact data the root refers to
         self._att_data_by_root: dict[bytes, AttestationData] = {}
+        # inclusion simulation: pool attestations land in the next block
+        # materialized after submission; tests set drop_inclusions=True to
+        # simulate a chain that never includes them
+        # (ref: testutil/beaconmock + core/tracker/inclusion_internal_test.go)
+        self.drop_inclusions = False
+        self._att_pool: list = []
+        self._blocks: dict[int, list] = {}
 
     # -- chain metadata ---------------------------------------------------
 
@@ -166,13 +173,39 @@ class BeaconMock:
             aggregation_bits=tuple(i < 2 for i in range(128)),
         )
 
+    # -- chain/inclusion queries (ref: inclusion checker's BN surface) ----
+
+    async def block_attestations(self, slot: int):
+        """Attestations included in the block at `slot` (every slot has a
+        block in the mock chain). Pool attestations submitted before this
+        call land in the first block materialized afterwards."""
+        if slot not in self._blocks:
+            self._blocks[slot] = list(self._att_pool)
+            self._att_pool.clear()
+        return self._blocks[slot]
+
+    async def block_root(self, slot: int):
+        """Root of the block at `slot`: the submitted proposal's header
+        root if one was broadcast for this slot, else the mock chain's
+        deterministic root."""
+        for proposal, _sig in self.proposals:
+            if proposal.header.slot == slot:
+                return proposal.hash_tree_root()
+        return self._root("block", slot)
+
     # -- submissions ------------------------------------------------------
 
     async def submit_attestation(self, att) -> None:
         self.attestations.append(att)
+        if not self.drop_inclusions:
+            self._att_pool.append(att)
 
     async def submit_aggregate(self, agg_and_proof, signature: bytes) -> None:
         self.aggregates.append((agg_and_proof, signature))
+        if not self.drop_inclusions:
+            agg = getattr(agg_and_proof, "aggregate", None)
+            if agg is not None:
+                self._att_pool.append(agg)
 
     async def submit_sync_message(self, msg) -> None:
         self.sync_messages.append(msg)

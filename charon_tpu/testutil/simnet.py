@@ -17,6 +17,7 @@ from charon_tpu.core.bcast import Broadcaster
 from charon_tpu.core.consensus import ConsensusController, EchoConsensus
 from charon_tpu.core.dutydb import DutyDB
 from charon_tpu.core.fetcher import Fetcher
+from charon_tpu.core.inclusion import InclusionChecker
 from charon_tpu.core.parsigdb import ParSigDB
 from charon_tpu.core.parsigex import Eth2Verifier, MemTransport, ParSigEx
 from charon_tpu.core.scheduler import Scheduler
@@ -59,6 +60,7 @@ class SimNode:
     aggsigdb: AggSigDB
     bcast: Broadcaster
     consensus: ConsensusController
+    inclusion: InclusionChecker | None = None
 
 
 def build_cluster(
@@ -219,6 +221,11 @@ def _build_node(
     if wire_vmock:
         scheduler.subscribe_duties(on_duty)
 
+    # inclusion checker (ref: core/tracker/inclusion.go wiring)
+    inclusion = InclusionChecker(beacon)
+    bcast.subscribe(inclusion.submitted)
+    scheduler.subscribe_slots(inclusion.on_slot)
+
     return SimNode(
         share_idx=share_idx,
         scheduler=scheduler,
@@ -230,4 +237,5 @@ def _build_node(
         aggsigdb=aggsigdb,
         bcast=bcast,
         consensus=consensus,
+        inclusion=inclusion,
     )

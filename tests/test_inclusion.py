@@ -1,0 +1,140 @@
+"""Inclusion checker: broadcast duties verified on-chain within 32 slots.
+
+Mirrors ref: core/tracker/inclusion.go (+ inclusion_internal_test.go):
+included attestations/aggregates/proposals are reported with their delay;
+dropped broadcasts are reported missed after INCL_CHECK_LAG slots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from charon_tpu.core.bcast import Broadcaster
+from charon_tpu.core.eth2data import (
+    AttestationData,
+    Checkpoint,
+    SignedData,
+)
+from charon_tpu.core.inclusion import INCL_CHECK_LAG, InclusionChecker
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.testutil.beaconmock import BeaconMock
+
+
+@dataclass(frozen=True)
+class _Slot:
+    slot: int
+    slots_per_epoch: int = 8
+
+
+def _att_duty(beacon: BeaconMock, slot: int):
+    from charon_tpu.core.eth2data import Attestation
+
+    data = beacon.attestation_data_fn(slot, 0)
+    att = Attestation(
+        aggregation_bits=(True,), data=data, signature=b"\x11" * 96
+    )
+    duty = Duty(slot=slot, type=DutyType.ATTESTER)
+    return duty, {b"\xaa" * 48: SignedData("attestation", att, b"\x11" * 96)}
+
+
+def test_attestation_included_with_delay():
+    async def run():
+        beacon = BeaconMock()
+        reports = []
+        checker = InclusionChecker(beacon, on_report=reports.append)
+        bcast = Broadcaster(beacon=beacon)
+        bcast.subscribe(checker.submitted)
+
+        duty, data_set = _att_duty(beacon, slot=10)
+        await bcast.broadcast(duty, data_set)
+
+        # blocks trail the tick by one slot: the slot-11 tick inspects
+        # block 10, which carries the pooled attestation
+        await checker.on_slot(_Slot(11))
+        assert len(reports) == 1
+        assert reports[0].included and reports[0].delay_slots == 0
+        assert checker.included_total == 1 and checker.missed_total == 0
+
+    asyncio.run(run())
+
+
+def test_dropped_attestation_reported_missed():
+    async def run():
+        beacon = BeaconMock()
+        beacon.drop_inclusions = True  # chain never includes submissions
+        reports = []
+        checker = InclusionChecker(beacon, on_report=reports.append)
+        bcast = Broadcaster(beacon=beacon)
+        bcast.subscribe(checker.submitted)
+
+        duty, data_set = _att_duty(beacon, slot=10)
+        await bcast.broadcast(duty, data_set)
+
+        # within the lag window: still pending, no report
+        await checker.on_slot(_Slot(10 + INCL_CHECK_LAG))
+        assert reports == []
+        # one slot past the lag: reported missed
+        await checker.on_slot(_Slot(10 + INCL_CHECK_LAG + 1))
+        assert len(reports) == 1
+        assert not reports[0].included
+        assert checker.missed_total == 1
+
+    asyncio.run(run())
+
+
+def test_proposal_included_by_block_root():
+    async def run():
+        beacon = BeaconMock()
+        reports = []
+        checker = InclusionChecker(beacon, on_report=reports.append)
+        bcast = Broadcaster(beacon=beacon)
+        bcast.subscribe(checker.submitted)
+
+        proposal = await beacon.block_proposal(12, 0, b"\x22" * 96)
+        duty = Duty(slot=12, type=DutyType.PROPOSER)
+        data_set = {b"\xbb" * 48: SignedData("block", proposal, b"\x33" * 96)}
+        await bcast.broadcast(duty, data_set)
+
+        # block 12 is inspected at the slot-13 tick (one-slot trail)
+        await checker.on_slot(_Slot(13))
+        assert len(reports) == 1
+        assert reports[0].included and reports[0].delay_slots == 0
+
+    asyncio.run(run())
+
+
+def test_wrong_bits_not_counted_as_included():
+    """A chain attestation with the same data but non-covering bits must
+    not satisfy the submission (ref: inclusion.go bits subset check)."""
+
+    async def run():
+        from charon_tpu.core.eth2data import Attestation
+
+        beacon = BeaconMock()
+        beacon.drop_inclusions = True
+        reports = []
+        checker = InclusionChecker(beacon, on_report=reports.append)
+
+        data = AttestationData(
+            slot=5,
+            index=0,
+            beacon_block_root=b"\x01" * 32,
+            source=Checkpoint(0, b"\x02" * 32),
+            target=Checkpoint(1, b"\x03" * 32),
+        )
+        ours = Attestation(
+            aggregation_bits=(False, True), data=data, signature=b"\x11" * 96
+        )
+        duty = Duty(slot=5, type=DutyType.ATTESTER)
+        await checker.submitted(
+            duty, {b"\xcc" * 48: SignedData("attestation", ours, b"\x11" * 96)}
+        )
+        # chain block carries same data root but only bit 0 set
+        beacon._blocks[6] = [
+            Attestation(aggregation_bits=(True, False), data=data)
+        ]
+        await checker.on_slot(_Slot(7))  # inspects block 6
+        assert reports == []  # not included: our bit 1 is not covered
+
+    asyncio.run(run())
