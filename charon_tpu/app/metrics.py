@@ -82,6 +82,37 @@ class ClusterMetrics:
         return generate_latest(self.registry)
 
 
+# wire() edges -> the counter each one increments when it fires
+# (ref: the reference instruments components directly; one wire option
+# keeps the components metric-free here)
+_EDGE_COUNTERS = {
+    "fetcher.fetch": "duty_total",
+    "dutydb.store": "consensus_decided",
+    "parsigdb.store_external": "parsig_received",
+    "sigagg.aggregate": "sigagg_total",
+    "broadcaster.broadcast": "bcast_total",
+}
+
+
+def instrument(metrics: "ClusterMetrics"):
+    """wire() option: count workflow-edge completions per duty type."""
+
+    def option(name: str, fn):
+        attr = _EDGE_COUNTERS.get(name)
+        if attr is None:
+            return fn
+        counter = getattr(metrics, attr)
+
+        async def wrapped(duty, *args, **kwargs):
+            result = await fn(duty, *args, **kwargs)
+            metrics.labels(counter, str(duty.type.name.lower())).inc()
+            return result
+
+        return wrapped
+
+    return option
+
+
 async def serve_monitoring(
     host: str,
     port: int,
@@ -101,6 +132,21 @@ async def serve_monitoring(
             if path.startswith("/metrics"):
                 body = metrics.render()
                 ctype = b"text/plain; version=0.0.4"
+                status = b"200 OK"
+            elif path.startswith("/debug/traces"):
+                # recorded workflow spans (ref: app/monitoringapi.go debug
+                # endpoints + /debug/consensus, docs/consensus.md:74)
+                import json as _json
+
+                from charon_tpu.app import tracer as _tracer
+
+                trace_id = None
+                if "?trace_id=" in path:
+                    trace_id = path.split("?trace_id=")[1].split("&")[0]
+                body = _json.dumps(
+                    _tracer.global_tracer().dump(trace_id)
+                ).encode()
+                ctype = b"application/json"
                 status = b"200 OK"
             elif path.startswith("/livez"):
                 body = b"ok"

@@ -15,10 +15,10 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from charon_tpu import tbls
-from charon_tpu.app import k1util, log
+from charon_tpu.app import k1util, log, tracer
 from charon_tpu.app.eth2wrap import MultiClient, ValidatorCache
 from charon_tpu.app.lifecycle import LifecycleManager, Order
-from charon_tpu.app.metrics import ClusterMetrics, serve_monitoring
+from charon_tpu.app.metrics import ClusterMetrics, instrument, serve_monitoring
 from charon_tpu.cluster.lock import ClusterLock
 from charon_tpu.core.aggsigdb import AggSigDB
 from charon_tpu.core.bcast import Broadcaster
@@ -37,7 +37,7 @@ from charon_tpu.core.types import PubKey, pubkey_from_bytes
 from charon_tpu.core.validatorapi import ValidatorAPI
 from charon_tpu.core.vapi_http import VapiRouter
 from charon_tpu.core.wire import wire
-from charon_tpu.eth2util import keystore
+from charon_tpu.eth2util import enr, keystore
 from charon_tpu.eth2util.signing import ForkInfo
 from charon_tpu.p2p.adapters import TcpParSigTransport, TcpQbftNet
 from charon_tpu.p2p.transport import P2PNode, PeerSpec
@@ -56,6 +56,7 @@ class Config:
     peer_addrs: list[tuple[str, int]] = field(default_factory=list)
     beacon_nodes: list = field(default_factory=list)  # client objects
     simnet: bool = False
+    simnet_vmock: bool = True  # in-process VC in simnet (ref: app/vmock.go)
     slot_duration: float = 12.0
     slots_per_epoch: int = 32
     genesis_time: float | None = None
@@ -82,7 +83,11 @@ class Node:
 
 async def build_node(config: Config) -> Node:
     data_dir = Path(config.data_dir)
-    lock = ClusterLock.load(str(data_dir / "cluster-lock.json"))
+    # manifest mutation-DAG takes precedence over the plain lock
+    # (ref: app/app.go:166 loadClusterManifest)
+    from charon_tpu.cluster.manifest import load_cluster_state
+
+    lock = load_cluster_state(data_dir)
     n = len(lock.definition.operators)
     t = lock.definition.threshold
     share_idx = config.node_index + 1
@@ -111,13 +116,7 @@ async def build_node(config: Config) -> Node:
         (data_dir / "charon-enr-private-key").read_bytes()
     )
 
-    fork = ForkInfo(
-        genesis_validators_root=hashlib.sha256(
-            b"gvr" + lock.lock_hash()
-        ).digest(),
-        fork_version=bytes.fromhex(lock.definition.fork_version[2:]),
-        genesis_fork_version=bytes.fromhex(lock.definition.fork_version[2:]),
-    )
+    fork = lock.fork_info()
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -156,7 +155,7 @@ async def build_node(config: Config) -> Node:
         specs = []
         for i, (host, port) in enumerate(config.peer_addrs):
             # operator ENR field carries the k1 pubkey hex in this format
-            pub = bytes.fromhex(lock.definition.operators[i].enr.split(":")[-1])
+            pub = enr.pubkey_from_string(lock.definition.operators[i].enr)
             specs.append(PeerSpec(index=i, pubkey=pub, host=host, port=port))
         p2p_node = P2PNode(
             config.node_index, k1_key, specs, lock.lock_hash()
@@ -186,7 +185,7 @@ async def build_node(config: Config) -> Node:
     # justification) is signed/verified against the operators' keys
     # (ref: core/consensus/qbft/transport.go:25-50, qbft.go:561).
     op_pubkeys = [
-        bytes.fromhex(op.enr.split(":")[-1])
+        enr.pubkey_from_string(op.enr)
         for op in lock.definition.operators
     ]
     duty_gater = DutyGater(clock, slots_per_epoch=config.slots_per_epoch)
@@ -223,7 +222,7 @@ async def build_node(config: Config) -> Node:
         sigagg=sigagg,
         aggsigdb=aggsigdb,
         broadcaster=bcast,
-        options=[tracking(tracker)],
+        options=[tracking(tracker), tracer.tracing(), instrument(metrics)],
     )
 
     # deadliner trims stores + triggers tracker analysis
@@ -240,6 +239,48 @@ async def build_node(config: Config) -> Node:
         inclusion = InclusionChecker(beacon, on_report=_log_inclusion)
         bcast.subscribe(inclusion.submitted)
         scheduler.subscribe_slots(inclusion.on_slot)
+
+    # in-process validator client for simnet runs (ref: app/vmock.go —
+    # the reference wires validatormock when --simnet-validator-mock)
+    if config.simnet and config.simnet_vmock:
+        from charon_tpu.core.types import DutyType
+        from charon_tpu.testutil.validatormock import ValidatorMock
+
+        vmock = ValidatorMock(
+            vapi=vapi,
+            share_keys=share_keys,
+            fork=fork,
+            slots_per_epoch=config.slots_per_epoch,
+        )
+
+        # keep strong refs to fire-and-forget proposer tasks and surface
+        # their failures (asyncio holds tasks weakly)
+        vmock_tasks: set[asyncio.Task] = set()
+
+        def _spawn(coro, what: str) -> None:
+            task = asyncio.create_task(coro)
+            vmock_tasks.add(task)
+
+            def done(t: asyncio.Task) -> None:
+                vmock_tasks.discard(t)
+                if not t.cancelled() and t.exception() is not None:
+                    log.error(
+                        "vmock duty failed",
+                        topic="vmock",
+                        exc=t.exception(),
+                        duty=what,
+                    )
+
+            task.add_done_callback(done)
+
+        async def on_duty(duty, defs):
+            if duty.type == DutyType.ATTESTER:
+                await vmock.attest(duty.slot, defs)
+            elif duty.type == DutyType.PROPOSER:
+                for pubkey in defs:
+                    _spawn(vmock.propose(duty.slot, pubkey), str(duty))
+
+        scheduler.subscribe_duties(on_duty)
 
     vapi_router = VapiRouter(
         vapi,

@@ -1,0 +1,158 @@
+"""Workflow tracing: duty-rooted spans across every wire edge.
+
+Mirrors ref: app/tracer/trace.go (OpenTelemetry -> Jaeger) and
+core/tracing.go (span-wrapped workflow steps, duty-rooted trace IDs via
+StartDutyTrace). Redesign: a dependency-free span recorder — spans carry
+OTel-compatible ids (128-bit trace, 64-bit span), nest via contextvars
+(async-safe), and export to a ring buffer served at /debug/traces plus an
+optional JSONL file. Duty traces use a DETERMINISTIC trace id derived
+from the duty, so spans recorded on different nodes of the cluster can be
+merged into one cross-node trace offline — same property the reference
+gets from propagating trace context in its p2p envelopes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+import json
+import os
+import secrets
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    parent_id: str  # 16 hex chars or ""
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: dict = field(default_factory=dict)
+    status: str = "ok"  # ok | error
+
+    def to_json(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_us": int(self.start * 1e6),
+            "duration_us": int((self.end - self.start) * 1e6),
+            "attrs": self.attrs,
+            "status": self.status,
+        }
+
+
+_current: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
+    "charon_tpu_span", default=None
+)
+
+
+class Tracer:
+    """Ring-buffered span store with optional JSONL export
+    (ref: app/tracer Init wiring, app/app.go:1014-1027)."""
+
+    def __init__(self, capacity: int = 4096, jsonl_path: str | None = None):
+        self.spans: deque[Span] = deque(maxlen=capacity)
+        self.jsonl_path = jsonl_path
+        self._file = None
+
+    def record(self, span: Span) -> None:
+        self.spans.append(span)
+        if self.jsonl_path:
+            if self._file is None:
+                os.makedirs(
+                    os.path.dirname(self.jsonl_path) or ".", exist_ok=True
+                )
+                self._file = open(self.jsonl_path, "a")
+            self._file.write(json.dumps(span.to_json()) + "\n")
+            self._file.flush()
+
+    def dump(self, trace_id: str | None = None) -> list[dict]:
+        return [
+            s.to_json()
+            for s in self.spans
+            if trace_id is None or s.trace_id == trace_id
+        ]
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+_GLOBAL = Tracer()
+
+
+def global_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def set_global_tracer(tracer: Tracer) -> None:
+    global _GLOBAL
+    _GLOBAL = tracer
+
+
+def duty_trace_id(duty) -> str:
+    """Deterministic trace id for a duty — identical on every node
+    (ref: core/tracing.go StartDutyTrace derives the id from the duty)."""
+    return hashlib.sha256(
+        b"charon-tpu-trace" + str(duty).encode()
+    ).hexdigest()[:32]
+
+
+@contextlib.contextmanager
+def span(name: str, duty=None, tracer: Tracer | None = None, **attrs):
+    """Start a span; nests under the context's current span. If `duty` is
+    given and there is no active trace, the span roots a duty trace."""
+    tracer = tracer or _GLOBAL
+    parent = _current.get()
+    if parent is not None:
+        trace_id = parent.trace_id
+        parent_id = parent.span_id
+    elif duty is not None:
+        trace_id = duty_trace_id(duty)
+        parent_id = ""
+    else:
+        trace_id = secrets.token_hex(16)
+        parent_id = ""
+    if duty is not None:
+        attrs.setdefault("duty", str(duty))
+    s = Span(
+        trace_id=trace_id,
+        span_id=secrets.token_hex(8),
+        parent_id=parent_id,
+        name=name,
+        start=time.time(),
+        attrs=attrs,
+    )
+    token = _current.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.status = "error"
+        s.attrs["error"] = repr(e)
+        raise
+    finally:
+        s.end = time.time()
+        _current.reset(token)
+        tracer.record(s)
+
+
+def tracing(tracer: Tracer | None = None):
+    """wire() option wrapping every subscription edge in a span
+    (ref: core/tracing.go + core.WithTracing, app/app.go:569)."""
+
+    def option(name: str, fn):
+        async def wrapped(duty, *args, **kwargs):
+            with span(name, duty=duty, tracer=tracer):
+                return await fn(duty, *args, **kwargs)
+
+        return wrapped
+
+    return option

@@ -2,9 +2,8 @@
 
 Mirrors ref: cluster/definition.go — operators agree on (name, validators,
 threshold, fork) before DKG; each operator signs the config hash and their
-ENR with their secp256k1 key (the reference uses EIP-712 typed signing;
-here the signed payload is the canonical-JSON config hash domain-tagged,
-same authorization semantics).
+ENR with their secp256k1 key as EIP-712 typed data (wallet-displayable,
+ref: cluster/eip712sigs.go).
 """
 
 from __future__ import annotations
@@ -18,7 +17,6 @@ from charon_tpu.app import k1util
 
 DEFINITION_VERSION = "ctpu/v1.0"
 _CONFIG_DOMAIN = b"charon-tpu/definition-config-hash"
-_ENR_DOMAIN = b"charon-tpu/operator-enr"
 
 
 def _canonical(obj) -> bytes:
@@ -95,15 +93,44 @@ class ClusterDefinition:
         ]
         return hashlib.sha256(_CONFIG_DOMAIN + _canonical(payload)).digest()
 
-    # -- signing ----------------------------------------------------------
+    # -- signing (EIP-712 typed data, ref: cluster/eip712sigs.go) ----------
+
+    def _eip712_domain(self):
+        from charon_tpu.eth2util.eip712 import Domain
+
+        return Domain(name="charon-tpu", version="1.0", chain_id=1)
+
+    def config_signature_digest(self) -> bytes:
+        """EIP-712 digest over the config hash — what wallets display and
+        operators sign (ref: eip712sigs.go OperatorConfigHash type)."""
+        from charon_tpu.eth2util.eip712 import Field, TypedData, hash_typed_data
+
+        return hash_typed_data(
+            self._eip712_domain(),
+            TypedData(
+                primary_type="OperatorConfigHash",
+                fields=(
+                    Field("config_hash", "bytes32", self.config_hash()),
+                ),
+            ),
+        )
+
+    def enr_signature_digest(self, enr: str) -> bytes:
+        from charon_tpu.eth2util.eip712 import Field, TypedData, hash_typed_data
+
+        return hash_typed_data(
+            self._eip712_domain(),
+            TypedData(
+                primary_type="ENR",
+                fields=(Field("enr", "string", enr),),
+            ),
+        )
 
     def sign_operator(self, op_index: int, privkey) -> "ClusterDefinition":
-        """Operator signs config hash + their ENR (ref: EIP-712 sigs,
-        cluster/eip712sigs.go)."""
+        """Operator signs the EIP-712 config digest + their ENR digest."""
         op = self.operators[op_index]
-        cfg_sig = k1util.sign(privkey, self.config_hash())
-        enr_digest = hashlib.sha256(_ENR_DOMAIN + op.enr.encode()).digest()
-        enr_sig = k1util.sign(privkey, enr_digest)
+        cfg_sig = k1util.sign(privkey, self.config_signature_digest())
+        enr_sig = k1util.sign(privkey, self.enr_signature_digest(op.enr))
         new_op = replace(
             op,
             config_signature=cfg_sig.hex(),
@@ -117,19 +144,18 @@ class ClusterDefinition:
         """pubkeys: 33-byte compressed k1 key per operator."""
         if len(pubkeys) != len(self.operators):
             raise ValueError("pubkey count mismatch")
-        cfg_hash = self.config_hash()
+        cfg_digest = self.config_signature_digest()
         for op, pk in zip(self.operators, pubkeys):
             if not op.config_signature or not op.enr_signature:
                 raise ValueError(f"operator {op.address} has not signed")
             if not k1util.verify_bytes(
-                pk, cfg_hash, bytes.fromhex(op.config_signature)
+                pk, cfg_digest, bytes.fromhex(op.config_signature)
             ):
                 raise ValueError(f"bad config signature for {op.address}")
-            enr_digest = hashlib.sha256(
-                _ENR_DOMAIN + op.enr.encode()
-            ).digest()
             if not k1util.verify_bytes(
-                pk, enr_digest, bytes.fromhex(op.enr_signature)
+                pk,
+                self.enr_signature_digest(op.enr),
+                bytes.fromhex(op.enr_signature),
             ):
                 raise ValueError(f"bad ENR signature for {op.address}")
 

@@ -52,6 +52,22 @@ class ClusterLock:
         }
         return hashlib.sha256(_LOCK_DOMAIN + _canonical(payload)).digest()
 
+    def fork_info(self):
+        """The cluster's signing ForkInfo: fork version from the
+        definition, genesis validators root derived from the lock hash
+        (single source shared by the node runtime and the exit CLI so
+        signing roots always agree)."""
+        from charon_tpu.eth2util.signing import ForkInfo
+
+        fv = bytes.fromhex(self.definition.fork_version[2:])
+        return ForkInfo(
+            genesis_validators_root=hashlib.sha256(
+                b"gvr" + self.lock_hash()
+            ).digest(),
+            fork_version=fv,
+            genesis_fork_version=fv,
+        )
+
     # -- verification (ref: cluster/lock.go VerifySignatures) -------------
 
     def verify(self, operator_k1_pubkeys: list[bytes] | None = None) -> None:

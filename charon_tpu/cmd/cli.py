@@ -37,6 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
     runp.add_argument("--p2p-port", type=int, default=int(_env_default("p2p-port", 3610)))
     runp.add_argument("--slot-duration", type=float, default=float(_env_default("slot-duration", 12.0)))
     runp.add_argument(
+        "--genesis-time",
+        type=float,
+        default=float(_env_default("genesis-time", 0.0)) or None,
+        help="unix genesis timestamp (aligns simnet clocks across processes)",
+    )
+    runp.add_argument("--slots-per-epoch", type=int, default=int(_env_default("slots-per-epoch", 32)))
+    runp.add_argument(
         "--peers",
         default=_env_default("peers", ""),
         help="comma-separated host:port per operator (index order)",
@@ -105,6 +112,74 @@ def build_parser() -> argparse.ArgumentParser:
     enrp = sub.add_parser("enr", help="print this node's identity record")
     enrp.add_argument("--data-dir", default=".charon")
 
+    comb = sub.add_parser(
+        "combine",
+        help="reconstruct group validator keys from >=threshold node dirs",
+    )
+    comb.add_argument(
+        "--cluster-dir",
+        required=True,
+        help="directory containing node*/ data dirs from the same cluster",
+    )
+    comb.add_argument("--output-dir", required=True)
+    comb.add_argument(
+        "--force", action="store_true", help="overwrite existing output"
+    )
+
+    exitp = sub.add_parser("exit", help="voluntary-exit operations")
+    exitsub = exitp.add_subparsers(dest="exit_command", required=True)
+    esign = exitsub.add_parser(
+        "sign", help="sign this node's partial voluntary exit"
+    )
+    esign.add_argument("--data-dir", required=True)
+    esign.add_argument("--validator-index", type=int, required=True)
+    esign.add_argument(
+        "--validator-pubkey", default="", help="0x group pubkey (default: by index order in lock)"
+    )
+    esign.add_argument("--epoch", type=int, required=True)
+    esign.add_argument("--output", default="", help="partial-exit json path")
+    ebcast = exitsub.add_parser(
+        "broadcast",
+        help="aggregate >=threshold partial exits and emit the signed exit",
+    )
+    ebcast.add_argument("--data-dir", required=True)
+    ebcast.add_argument(
+        "--partials", nargs="+", required=True, help="partial-exit json files"
+    )
+    ebcast.add_argument("--output", default="", help="signed-exit json path")
+    ebcast.add_argument(
+        "--beacon-url", default="", help="POST the exit to this beacon node"
+    )
+
+    relayp = sub.add_parser("relay", help="run a rendezvous relay server")
+    relayp.add_argument("--port", type=int, default=3640)
+    relayp.add_argument("--host", default="0.0.0.0")
+
+    alpha = sub.add_parser("alpha", help="experimental commands")
+    alphasub = alpha.add_subparsers(dest="alpha_command", required=True)
+    addv = alphasub.add_parser(
+        "add-validators",
+        help="solo: add validators to an existing cluster via the "
+        "manifest mutation chain",
+    )
+    addv.add_argument(
+        "--cluster-dir",
+        required=True,
+        help="directory with ALL node*/ data dirs (solo operator)",
+    )
+    addv.add_argument("--count", type=int, default=1)
+
+    testp = sub.add_parser("test", help="operator diagnostics")
+    testsub = testp.add_subparsers(dest="test_command", required=True)
+    tpeers = testsub.add_parser("peers", help="measure peer connectivity")
+    tpeers.add_argument(
+        "--peers", required=True, help="comma-separated host:port list"
+    )
+    tpeers.add_argument("--count", type=int, default=5)
+    tbeacon = testsub.add_parser("beacon", help="measure beacon-node latency")
+    tbeacon.add_argument("--beacon-url", required=True)
+    tbeacon.add_argument("--count", type=int, default=5)
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -116,6 +191,7 @@ def cmd_create_cluster(args) -> int:
     from charon_tpu.cluster.definition import ClusterDefinition, Operator
     from charon_tpu.dkg import frost
     from charon_tpu.dkg.ceremony import MemExchangeNet, run_dkg
+    from charon_tpu.eth2util import enr as enrlib
 
     n, t, v = args.nodes, args.threshold, args.validators
     out = Path(args.output_dir)
@@ -123,8 +199,7 @@ def cmd_create_cluster(args) -> int:
     ops = tuple(
         Operator(
             address=f"operator-{i}",
-            enr="enr:node-%d:%s"
-            % (i, k1util.public_key_to_bytes(keys[i].public_key()).hex()),
+            enr=enrlib.new(keys[i]).to_string(),
         )
         for i in range(n)
     )
@@ -157,9 +232,9 @@ def cmd_create_cluster(args) -> int:
 
     results = asyncio.run(ceremony())
     for i in range(n):
-        (out / f"node{i}" / "charon-enr-private-key").write_bytes(
-            k1util.private_key_to_bytes(keys[i])
-        )
+        key_path = out / f"node{i}" / "charon-enr-private-key"
+        key_path.touch(mode=0o600)
+        key_path.write_bytes(k1util.private_key_to_bytes(keys[i]))
     (out / "cluster-definition.json").write_text(
         json.dumps(defn.to_json(), indent=2)
     )
@@ -185,6 +260,8 @@ def cmd_run(args) -> int:
         peer_addrs=peer_addrs,
         simnet=args.simnet,
         slot_duration=args.slot_duration,
+        slots_per_epoch=args.slots_per_epoch,
+        genesis_time=args.genesis_time,
         use_tpu_tbls=not args.no_tpu,
     )
     asyncio.run(run(config))
@@ -202,10 +279,15 @@ def _operator_index_for_key(defn, key) -> int:
     """This key's 0-based operator index in the definition, or -1."""
     from charon_tpu.app import k1util
 
-    my_pub = k1util.public_key_to_bytes(key.public_key()).hex()
+    from charon_tpu.eth2util import enr
+
+    my_pub = k1util.public_key_to_bytes(key.public_key())
     for i, op in enumerate(defn.operators):
-        if op.enr.split(":")[-1] == my_pub:
-            return i
+        try:
+            if enr.pubkey_from_string(op.enr) == my_pub:
+                return i
+        except ValueError:
+            continue
     return -1
 
 
@@ -247,8 +329,13 @@ def cmd_dkg(args) -> int:
             engine = blsops.BlsEngine(
                 limb.default_fp_ctx(), limb.default_fr_ctx()
             )
-        except Exception:
-            engine = None  # host fallback
+        except Exception as e:
+            print(
+                f"warning: TPU engine unavailable ({type(e).__name__}: {e}); "
+                "running ceremony on the host crypto path",
+                file=sys.stderr,
+            )
+            engine = None
 
     result = asyncio.run(
         run_networked_dkg(
@@ -266,8 +353,9 @@ def cmd_dkg(args) -> int:
 
 
 def cmd_create_enr(args) -> int:
-    """ref: cmd/createenr.go — new key + printed record."""
+    """ref: cmd/createenr.go — new key + printed EIP-778 record."""
     from charon_tpu.app import k1util
+    from charon_tpu.eth2util import enr as enrlib
 
     data_dir = Path(args.data_dir)
     data_dir.mkdir(parents=True, exist_ok=True)
@@ -276,8 +364,9 @@ def cmd_create_enr(args) -> int:
         print(f"refusing to overwrite {key_path}", file=sys.stderr)
         return 1
     key = k1util.generate_private_key()
+    key_path.touch(mode=0o600)
     key_path.write_bytes(k1util.private_key_to_bytes(key))
-    print("enr:" + k1util.public_key_to_bytes(key.public_key()).hex())
+    print(enrlib.new(key).to_string())
     return 0
 
 
@@ -292,6 +381,9 @@ def cmd_create_dkg(args) -> int:
         print("need at least 3 operators", file=sys.stderr)
         return 1
     threshold = args.threshold or n - (n - 1) // 3
+    if not 1 < threshold <= n:
+        print(f"threshold must be in (1, {n}], got {threshold}", file=sys.stderr)
+        return 1
     defn = ClusterDefinition(
         name=args.name,
         num_validators=args.num_validators,
@@ -327,12 +419,391 @@ def cmd_sign_definition(args) -> int:
 
 
 def cmd_enr(args) -> int:
-    from charon_tpu.app import k1util
+    from charon_tpu.eth2util import enr as enrlib
 
-    key_path = Path(args.data_dir) / "charon-enr-private-key"
-    key = k1util.private_key_from_bytes(key_path.read_bytes())
-    print("enr:" + k1util.public_key_to_bytes(key.public_key()).hex())
+    key = _load_node_key(args.data_dir)
+    print(enrlib.new(key).to_string())
     return 0
+
+
+def cmd_combine(args) -> int:
+    """Reconstruct the group private keys from >= threshold node dirs
+    (ref: cmd/combine — Lagrange-recover at x=0 from share keystores)."""
+    from charon_tpu import tbls
+    from charon_tpu.cluster.manifest import load_cluster_state
+    from charon_tpu.eth2util import keystore
+
+    cluster_dir = Path(args.cluster_dir)
+    node_dirs = sorted(
+        d
+        for d in cluster_dir.iterdir()
+        if d.is_dir() and (d / "cluster-lock.json").exists()
+    )
+    if not node_dirs:
+        print(f"no node dirs with cluster-lock.json in {cluster_dir}", file=sys.stderr)
+        return 1
+
+    # manifest-materialised state: includes validators added after the
+    # original ceremony (ref: app/app.go:166)
+    lock = load_cluster_state(node_dirs[0])
+    n = len(lock.definition.operators)
+    t = lock.definition.threshold
+    v = len(lock.validators)
+
+    # map each node dir to its share index by matching pubshares
+    shares_by_validator: list[dict[int, bytes]] = [dict() for _ in range(v)]
+    for d in node_dirs:
+        if load_cluster_state(d).lock_hash() != lock.lock_hash():
+            print(f"{d} belongs to a different cluster", file=sys.stderr)
+            return 1
+        secrets = keystore.load_keys(d / "validator_keys")
+        if len(secrets) != v:
+            print(f"{d} has {len(secrets)} keystores, want {v}", file=sys.stderr)
+            return 1
+        impl = tbls.get_implementation()
+        for vi, secret in enumerate(secrets):
+            pub = impl.secret_to_public_key(secret)
+            pubshares = [
+                bytes.fromhex(s[2:])
+                for s in lock.validators[vi].public_shares
+            ]
+            if pub not in pubshares:
+                print(f"{d} keystore {vi} matches no pubshare", file=sys.stderr)
+                return 1
+            shares_by_validator[vi][pubshares.index(pub) + 1] = secret
+
+    if any(len(s) < t for s in shares_by_validator):
+        got = min(len(s) for s in shares_by_validator)
+        print(f"need >= {t} share keystores per validator, got {got}", file=sys.stderr)
+        return 1
+
+    out = Path(args.output_dir)
+    if out.exists() and any(out.iterdir()) and not args.force:
+        print(f"{out} is not empty (use --force)", file=sys.stderr)
+        return 1
+    secrets, pubkeys = [], []
+    for vi in range(v):
+        secret = tbls.recover_secret(shares_by_validator[vi], n, t)
+        want = lock.validators[vi].distributed_public_key
+        have = "0x" + tbls.secret_to_public_key(secret).hex()
+        if want != have:
+            print(f"recovered key {vi} mismatches lock pubkey", file=sys.stderr)
+            return 1
+        secrets.append(secret)
+        pubkeys.append(want)
+    keystore.store_keys(secrets, out, pubkeys=pubkeys)
+    print(f"recovered {v} validator key(s) into {out}")
+    return 0
+
+
+def cmd_exit(args) -> int:
+    from charon_tpu import tbls
+    from charon_tpu.cluster.manifest import load_cluster_state
+    from charon_tpu.core.eth2data import SignedData, VoluntaryExit
+    from charon_tpu.eth2util import keystore
+
+    data_dir = Path(args.data_dir)
+    lock = load_cluster_state(data_dir)
+    fork = lock.fork_info()
+
+    if args.exit_command == "sign":
+        # ref: cmd/exit_sign.go — one partial exit signed with this
+        # node's share key
+        vi = args.validator_index
+        if vi >= len(lock.validators):
+            print("validator index out of range", file=sys.stderr)
+            return 1
+        dv = lock.validators[vi]
+        if args.validator_pubkey and args.validator_pubkey.lower() != dv.distributed_public_key.lower():
+            print("pubkey does not match lock validator at that index", file=sys.stderr)
+            return 1
+        secrets = keystore.load_keys(data_dir / "validator_keys")
+        secret = secrets[vi]
+        impl = tbls.get_implementation()
+        my_pubshare = impl.secret_to_public_key(secret)
+        share_idx = [
+            bytes.fromhex(s[2:]) for s in dv.public_shares
+        ].index(my_pubshare) + 1
+
+        exit_msg = VoluntaryExit(epoch=args.epoch, validator_index=vi)
+        root = SignedData("exit", exit_msg).signing_root(fork, args.epoch)
+        sig = tbls.sign(secret, root)
+        out = {
+            "validator_pubkey": dv.distributed_public_key,
+            "validator_index": vi,
+            "epoch": args.epoch,
+            "share_idx": share_idx,
+            "partial_signature": sig.hex(),
+        }
+        path = args.output or str(
+            data_dir / f"exit-partial-{vi}-{share_idx}.json"
+        )
+        Path(path).write_text(json.dumps(out, indent=2))
+        print(f"wrote partial exit {path}")
+        return 0
+
+    # broadcast: aggregate >= t partials, verify, emit/submit
+    # (ref: cmd/exit_broadcast.go)
+    partials = [json.loads(Path(p).read_text()) for p in args.partials]
+    vi = partials[0]["validator_index"]
+    epoch = partials[0]["epoch"]
+    if any(p["validator_index"] != vi or p["epoch"] != epoch for p in partials):
+        print("partials disagree on validator/epoch", file=sys.stderr)
+        return 1
+    if not 0 <= vi < len(lock.validators):
+        print(
+            f"partials reference validator {vi}, cluster has "
+            f"{len(lock.validators)}",
+            file=sys.stderr,
+        )
+        return 1
+    t = lock.definition.threshold
+    # dedup by share index BEFORE the threshold count/slice so duplicate
+    # files can't silently under-fill the quorum
+    by_share = {
+        p["share_idx"]: bytes.fromhex(p["partial_signature"])
+        for p in partials
+    }
+    if len(by_share) < t:
+        print(
+            f"need >= {t} distinct share partials, got {len(by_share)}",
+            file=sys.stderr,
+        )
+        return 1
+    exit_msg = VoluntaryExit(epoch=epoch, validator_index=vi)
+    root = SignedData("exit", exit_msg).signing_root(fork, epoch)
+    subset = dict(sorted(by_share.items())[:t])
+    sig = tbls.threshold_aggregate(subset)
+    group_pk = bytes.fromhex(
+        lock.validators[vi].distributed_public_key[2:]
+    )
+    try:
+        tbls.verify(group_pk, root, sig)
+    except Exception as e:
+        print(f"aggregated exit signature invalid: {e}", file=sys.stderr)
+        return 1
+    signed = {
+        "message": {"epoch": str(epoch), "validator_index": str(vi)},
+        "signature": "0x" + sig.hex(),
+    }
+    path = args.output or str(data_dir / f"exit-{vi}.json")
+    Path(path).write_text(json.dumps(signed, indent=2))
+    print(f"wrote signed exit {path}")
+    if args.beacon_url:
+        import aiohttp
+
+        async def submit():
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    args.beacon_url.rstrip("/")
+                    + "/eth/v1/beacon/pool/voluntary_exits",
+                    json=signed,
+                ) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"beacon rejected exit: HTTP {resp.status}"
+                        )
+
+        asyncio.run(submit())
+        print("broadcast to beacon node")
+    return 0
+
+
+def cmd_alpha(args) -> int:
+    """alpha add-validators: a solo operator (holding every node dir)
+    extends the cluster with new distributed validators via the manifest
+    mutation chain (ref: cmd/addvalidators.go add-validators-solo +
+    cluster/manifest mutations)."""
+    from charon_tpu.cluster.lock import DistributedValidator
+    from charon_tpu.cluster.manifest import Manifest, load_cluster_state
+    from charon_tpu.crypto.g1g2 import g1_to_bytes
+    from charon_tpu.dkg import frost
+    from charon_tpu.eth2util import keystore
+
+    cluster_dir = Path(args.cluster_dir)
+    node_dirs = sorted(
+        d
+        for d in cluster_dir.iterdir()
+        if d.is_dir() and (d / "charon-enr-private-key").exists()
+    )
+    if not node_dirs:
+        print(f"no node dirs in {cluster_dir}", file=sys.stderr)
+        return 1
+    lock = load_cluster_state(node_dirs[0])
+    n = len(lock.definition.operators)
+    t = lock.definition.threshold
+    if len(node_dirs) != n:
+        print(f"solo add-validators needs all {n} node dirs", file=sys.stderr)
+        return 1
+    # map each dir to its OPERATOR index via its key — directory sort
+    # order is lexicographic (node10 < node2) and must not decide share
+    # indices
+    by_op: dict[int, object] = {}
+    for d in node_dirs:
+        key = _load_node_key(d)
+        idx = _operator_index_for_key(lock.definition, key)
+        if idx < 0:
+            print(f"{d} key matches no operator", file=sys.stderr)
+            return 1
+        by_op[idx] = (d, key)
+    if sorted(by_op) != list(range(n)):
+        print("node dirs do not cover all operators", file=sys.stderr)
+        return 1
+    node_dirs = [by_op[i][0] for i in range(n)]
+    keys = [by_op[i][1] for i in range(n)]
+
+    # new FROST ceremony for the added validators only
+    async def ceremony():
+        net = frost.MemFrostTransport(n)
+        return await asyncio.gather(
+            *(
+                frost.run_frost_parallel(
+                    net.participant(i + 1),
+                    i + 1,
+                    n,
+                    t,
+                    args.count,
+                    lock.lock_hash(),  # context binds to the cluster
+                )
+                for i in range(n)
+            )
+        )
+
+    per_node_results = asyncio.run(ceremony())
+    new_validators = [
+        DistributedValidator(
+            distributed_public_key="0x"
+            + g1_to_bytes(r.group_pubkey).hex(),
+            public_shares=tuple(
+                "0x" + g1_to_bytes(r.pubshares[j]).hex()
+                for j in range(1, n + 1)
+            ),
+        )
+        for r in per_node_results[0]
+    ]
+
+    # manifest chain: genesis (if absent) -> add_validators -> approvals
+    manifest_path = node_dirs[0] / "cluster-manifest.json"
+    manifest = (
+        Manifest.load(str(manifest_path))
+        if manifest_path.exists()
+        else Manifest.genesis(lock)
+    )
+    mutation = manifest.propose_add_validators(new_validators)
+    manifest = manifest.append(mutation)
+    for key in keys:  # every operator approves (solo holds all keys)
+        manifest = manifest.append(manifest.approve(mutation.hash(), key))
+    state = manifest.materialise()
+
+    existing = len(lock.validators)
+    for i, d in enumerate(node_dirs):
+        manifest.save(str(d / "cluster-manifest.json"))
+        share_secrets = [
+            (r.secret_share % (1 << 256)).to_bytes(32, "big")
+            for r in per_node_results[i]
+        ]
+        keystore.store_keys(
+            share_secrets,
+            d / "validator_keys",
+            pubkeys=[dv.public_shares[i] for dv in new_validators],
+            start_index=existing,
+        )
+    print(
+        f"added {args.count} validator(s); cluster now has "
+        f"{len(state.validators)} (manifest head 0x{manifest.head().hex()[:16]})"
+    )
+    return 0
+
+
+def cmd_relay(args) -> int:
+    """ref: cmd/relay — run the rendezvous/forwarding relay daemon."""
+    from charon_tpu.p2p.relay import RelayServer
+
+    async def serve():
+        server = RelayServer()
+        port = await server.start(args.host, args.port)
+        print(f"relay listening on {args.host}:{port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_test(args) -> int:
+    """ref: cmd/test.go — operator diagnostics with latency stats."""
+    import statistics
+    import time
+
+    def stats_line(name, samples_ms, errs):
+        if samples_ms:
+            line = (
+                f"{name}: min={min(samples_ms):.1f}ms "
+                f"median={statistics.median(samples_ms):.1f}ms "
+                f"max={max(samples_ms):.1f}ms ok={len(samples_ms)}"
+            )
+        else:
+            line = f"{name}: unreachable"
+        if errs:
+            line += f" errors={errs}"
+        print(line)
+        return bool(samples_ms)
+
+    if args.test_command == "peers":
+        async def probe_peer(host, port):
+            samples, errs = [], 0
+            for _ in range(args.count):
+                t0 = time.perf_counter()
+                try:
+                    _, w = await asyncio.wait_for(
+                        asyncio.open_connection(host, port), timeout=3
+                    )
+                    samples.append((time.perf_counter() - t0) * 1000)
+                    w.close()
+                except Exception:
+                    errs += 1
+            return samples, errs
+
+        async def run_all():
+            ok = True
+            for part in args.peers.split(","):
+                host, port = part.rsplit(":", 1)
+                samples, errs = await probe_peer(host, int(port))
+                ok &= stats_line(f"peer {part}", samples, errs)
+            return 0 if ok else 1
+
+        return asyncio.run(run_all())
+
+    # test beacon
+    import aiohttp
+
+    async def probe_beacon():
+        samples, errs = [], 0
+        url = args.beacon_url.rstrip("/") + "/eth/v1/node/version"
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=3)
+        ) as s:
+            for _ in range(args.count):
+                t0 = time.perf_counter()
+                try:
+                    async with s.get(url) as resp:
+                        await resp.read()
+                        if resp.status == 200:
+                            samples.append(
+                                (time.perf_counter() - t0) * 1000
+                            )
+                        else:
+                            errs += 1
+                except Exception:
+                    errs += 1
+        return 0 if stats_line(f"beacon {args.beacon_url}", samples, errs) else 1
+
+    return asyncio.run(probe_beacon())
 
 
 def main(argv=None) -> int:
@@ -350,6 +821,11 @@ def main(argv=None) -> int:
         "create-dkg": cmd_create_dkg,
         "sign-definition": cmd_sign_definition,
         "enr": cmd_enr,
+        "combine": cmd_combine,
+        "exit": cmd_exit,
+        "relay": cmd_relay,
+        "alpha": cmd_alpha,
+        "test": cmd_test,
     }[args.command](args)
 
 

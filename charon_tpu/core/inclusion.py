@@ -104,6 +104,11 @@ class InclusionChecker:
         is only inspected at the N+1 tick, after its submissions exist.
         Then expire submissions past the lag."""
         current = slot.slot
+        if not self._pending:
+            # idle: nothing to look for — skip the beacon round-trips
+            # entirely rather than polling every slot forever
+            self._checked_until = current - 1
+            return
         start = self._checked_until
         if start is None:
             start = current - 2
@@ -123,8 +128,17 @@ class InclusionChecker:
         self._pending = still
 
     async def _check_block(self, block_slot: int) -> None:
-        atts = await self.beacon.block_attestations(block_slot)
-        root = await self.beacon.block_root(block_slot)
+        # fetch only what the pending submissions actually need
+        atts = (
+            await self.beacon.block_attestations(block_slot)
+            if any(p.att_data_root is not None for p in self._pending)
+            else None
+        )
+        root = (
+            await self.beacon.block_root(block_slot)
+            if any(p.block_root is not None for p in self._pending)
+            else None
+        )
         if atts is None and root is None:
             return  # no block this slot
         by_root: dict[bytes, list] = {}

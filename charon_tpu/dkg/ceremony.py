@@ -27,6 +27,7 @@ from charon_tpu.eth2util import keystore
 class DKGResult:
     lock: ClusterLock
     share_secrets: list[bytes]  # this node's share key per validator (32B)
+    deposits: list = None  # eth2util.deposit.DepositData per validator
 
 
 class MemExchangeNet:
@@ -124,6 +125,56 @@ async def run_dkg(
         sig_agg,
     )
 
+    # 4b. Deposit data: threshold-sign each validator's deposit message
+    # (ref: dkg/exchanger.go sigDepositData — partials exchanged and
+    # aggregated exactly like the lock signature).
+    from charon_tpu.eth2util import deposit as dep
+
+    fork_version = bytes.fromhex(defn.fork_version[2:])
+    deposit_msgs = [
+        dep.DepositMessage(
+            pubkey=bytes.fromhex(dv.distributed_public_key[2:]),
+            withdrawal_credentials=dep.withdrawal_credentials_bls(
+                bytes.fromhex(dv.distributed_public_key[2:])
+            ),
+            amount=dep.DEFAULT_AMOUNT_GWEI,
+        )
+        for dv in validators
+    ]
+    deposit_roots = [
+        dep.signing_root(m, fork_version) for m in deposit_msgs
+    ]
+    my_dep_partials = [
+        tbls.sign(share_secrets[i], deposit_roots[i]) for i in range(v)
+    ]
+    all_dep = await exchange_port.exchange(
+        "deposit-sig", [s.hex() for s in my_dep_partials]
+    )
+    deposit_sigs = tbls.threshold_aggregate_batch(
+        [
+            {
+                peer + 1: bytes.fromhex(all_dep[peer][i])
+                for peer in sorted(all_dep)
+            }
+            for i in range(v)
+        ]
+    )
+    deposits = []
+    for msg, sig, root, dv in zip(
+        deposit_msgs, deposit_sigs, deposit_roots, validators
+    ):
+        tbls.verify(
+            bytes.fromhex(dv.distributed_public_key[2:]), root, sig
+        )
+        deposits.append(
+            dep.DepositData(
+                pubkey=msg.pubkey,
+                withdrawal_credentials=msg.withdrawal_credentials,
+                amount=msg.amount,
+                signature=sig,
+            )
+        )
+
     # 5. Per-node k1 signatures over the lock hash
     # (ref: dkg/nodesigs.go via the reliable-broadcast component).
     my_node_sig = k1util.sign(k1_privkey, lock_hash)
@@ -139,7 +190,8 @@ async def run_dkg(
         ),
     )
 
-    # 6. Outputs (ref: dkg/disk.go — lock, keystores, passwords).
+    # 6. Outputs (ref: dkg/disk.go — lock, keystores, passwords,
+    # deposit-data.json).
     if data_dir is not None:
         data_dir = Path(data_dir)
         data_dir.mkdir(parents=True, exist_ok=True)
@@ -151,4 +203,9 @@ async def run_dkg(
                 dv.public_shares[node_idx] for dv in validators
             ],
         )
-    return DKGResult(lock=lock, share_secrets=share_secrets)
+        (data_dir / "deposit-data.json").write_text(
+            dep.deposit_data_json(deposits, fork_version, defn.name)
+        )
+    return DKGResult(
+        lock=lock, share_secrets=share_secrets, deposits=deposits
+    )

@@ -26,6 +26,7 @@ import hashlib
 import json
 
 from charon_tpu.app import k1util
+from charon_tpu.eth2util import enr
 from charon_tpu.dkg.frost import Round1Broadcast, Round1Shares
 from charon_tpu.p2p import codec
 from charon_tpu.p2p.transport import P2PNode
@@ -59,7 +60,7 @@ class TcpDkgTransport:
         self.def_hash = defn.definition_hash()
         self.privkey = privkey
         self.pubkeys = [
-            bytes.fromhex(op.enr.split(":")[-1]) for op in defn.operators
+            enr.pubkey_from_string(op.enr) for op in defn.operators
         ]
         self.poll_interval = poll_interval
         self.timeout = timeout
@@ -211,7 +212,7 @@ async def run_networked_dkg(
     from charon_tpu.p2p.transport import PeerSpec
 
     pubkeys = [
-        bytes.fromhex(op.enr.split(":")[-1]) for op in defn.operators
+        enr.pubkey_from_string(op.enr) for op in defn.operators
     ]
     # refuse to run a ceremony for a definition the operators didn't sign
     defn.verify_signatures(pubkeys)

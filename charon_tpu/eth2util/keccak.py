@@ -1,0 +1,85 @@
+"""Keccak-256 (the pre-NIST Ethereum flavour, NOT SHA3-256).
+
+Ethereum's ENR identity scheme (EIP-778 "v4") and EIP-712 typed-data
+hashing both use original Keccak with the 0x01 domain padding; Python's
+hashlib only ships the NIST SHA-3 variant (0x06 padding), so this is a
+small spec-exact keccak-f[1600] sponge. Pure Python is fine here: inputs
+are tiny (record payloads, typed-data structs), never bulk data.
+
+(ref: the reference gets this via go-ethereum's crypto.Keccak256 —
+eth2util/enr/enr.go, eth2util/eip712/eip712.go)
+"""
+
+from __future__ import annotations
+
+_ROUNDS = 24
+
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# rotation offsets r[x][y]
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+_MASK = (1 << 64) - 1
+
+
+def _rol(v: int, n: int) -> int:
+    n %= 64
+    return ((v << n) | (v >> (64 - n))) & _MASK
+
+
+def _keccak_f(a: list[list[int]]) -> None:
+    for rnd in range(_ROUNDS):
+        # theta
+        c = [a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rol(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x][y] ^= d[x]
+        # rho + pi
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rol(a[x][y], _ROT[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                a[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y])
+        # iota
+        a[0][0] ^= _RC[rnd]
+
+
+def keccak_256(data: bytes) -> bytes:
+    rate = 136  # 1088-bit rate for 256-bit output
+    # multi-rate padding with the 0x01 domain byte (keccak, not sha3's 0x06)
+    pad_len = rate - (len(data) % rate)
+    padded = data + b"\x01" + bytes(pad_len - 2) + b"\x80" if pad_len >= 2 else data + b"\x81"
+
+    state = [[0] * 5 for _ in range(5)]
+    for off in range(0, len(padded), rate):
+        block = padded[off : off + rate]
+        for i in range(rate // 8):
+            lane = int.from_bytes(block[8 * i : 8 * i + 8], "little")
+            x, y = i % 5, i // 5
+            state[x][y] ^= lane
+        _keccak_f(state)
+
+    out = b""
+    for i in range(4):  # 32 bytes = 4 lanes
+        x, y = i % 5, i // 5
+        out += state[x][y].to_bytes(8, "little")
+    return out

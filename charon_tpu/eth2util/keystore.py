@@ -89,16 +89,23 @@ def decrypt(keystore: dict, password: str) -> bytes:
 # -- directory layout (ref: keystore.go StoreKeys / LoadKeys) ----------------
 
 
-def store_keys(secrets_list: list[bytes], directory: str | Path, pubkeys: list[str] | None = None) -> None:
-    """Write keystore-N.json + keystore-N.txt password files."""
+def store_keys(
+    secrets_list: list[bytes],
+    directory: str | Path,
+    pubkeys: list[str] | None = None,
+    start_index: int = 0,
+) -> None:
+    """Write keystore-N.json + keystore-N.txt password files starting at
+    N = start_index (non-zero when appending validators to an existing
+    dir, ref: cmd/addvalidators.go)."""
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
-    for i, secret in enumerate(secrets_list):
+    for i, secret in enumerate(secrets_list, start=start_index):
         password = secrets.token_hex(16)
         ks = encrypt(
             secret,
             password,
-            pubkey_hex=(pubkeys[i] if pubkeys else ""),
+            pubkey_hex=(pubkeys[i - start_index] if pubkeys else ""),
             path=f"m/12381/3600/{i}/0/0",
         )
         (directory / f"keystore-{i}.json").write_text(json.dumps(ks, indent=2))

@@ -257,7 +257,13 @@ def point_scalar_mul(f: FieldOps, fr_ctx: ModCtx, p, scalars, nbits: int = 255):
     batch. ~nbits * (1 dbl + 1 add) field ops.
     """
     bits = _scalar_bits_msb(fr_ctx, scalars, nbits)
-    identity = point_identity(f, f.batch_shape(p[0]))
+    import jax
+
+    template = p[0][0] if isinstance(p[0], tuple) else p[0]
+    identity = jax.tree_util.tree_map(
+        lambda a: limb.match_vary(a, template),
+        point_identity(f, f.batch_shape(p[0])),
+    )
 
     def step(acc, bit):
         acc = point_double(f, acc)

@@ -51,48 +51,34 @@ def fp2_const(ctx: ModCtx, a, batch_shape=()):
 
 
 def fp2_add(ctx, a, b):
-    return (limb.add_mod(ctx, a[0], b[0]), limb.add_mod(ctx, a[1], b[1]))
+    r = limb.add_mod_many(ctx, [(a[0], b[0]), (a[1], b[1])])
+    return (r[0], r[1])
 
 
 def fp2_sub(ctx, a, b):
-    return (limb.sub_mod(ctx, a[0], b[0]), limb.sub_mod(ctx, a[1], b[1]))
+    r = limb.sub_mod_many(ctx, [(a[0], b[0]), (a[1], b[1])])
+    return (r[0], r[1])
 
 
 def fp2_neg(ctx, a):
-    return (limb.neg_mod(ctx, a[0]), limb.neg_mod(ctx, a[1]))
+    z = limb.zeros(ctx, a[0].shape[:-1])
+    r = limb.sub_mod_many(ctx, [(z, a[0]), (z, a[1])])
+    return (r[0], r[1])
 
 
 def fp2_double(ctx, a):
-    return (limb.double_mod(ctx, a[0]), limb.double_mod(ctx, a[1]))
+    return fp2_add(ctx, a, a)
 
 
 def fp2_mul(ctx, a, b):
-    """Karatsuba: 3 base muls.
-
-    c0 = a0 b0 - a1 b1;  c1 = (a0+a1)(b0+b1) - a0 b0 - a1 b1.
-    """
-    v0 = limb.mont_mul(ctx, a[0], b[0])
-    v1 = limb.mont_mul(ctx, a[1], b[1])
-    s = limb.mont_mul(
-        ctx,
-        limb.add_mod(ctx, a[0], a[1]),
-        limb.add_mod(ctx, b[0], b[1]),
-    )
-    return (
-        limb.sub_mod(ctx, v0, v1),
-        limb.sub_mod(ctx, limb.sub_mod(ctx, s, v0), v1),
-    )
+    """Karatsuba, 3 base muls, as a one-op stacked batch:
+    c0 = a0 b0 - a1 b1;  c1 = (a0+a1)(b0+b1) - a0 b0 - a1 b1."""
+    return fp2_batch(ctx, [("mul", a, b)])[0]
 
 
 def fp2_sqr(ctx, a):
     """(a0+a1)(a0-a1) + 2 a0 a1 u — 2 base muls."""
-    c0 = limb.mont_mul(
-        ctx,
-        limb.add_mod(ctx, a[0], a[1]),
-        limb.sub_mod(ctx, a[0], a[1]),
-    )
-    c1 = limb.double_mod(ctx, limb.mont_mul(ctx, a[0], a[1]))
-    return (c0, c1)
+    return fp2_batch(ctx, [("sqr", a)])[0]
 
 
 def fp2_mul_fp(ctx, a, s):
@@ -117,11 +103,61 @@ def fp2_small(ctx, a, k: int):
 
 def fp2_mul_xi(ctx, a):
     """Multiply by xi = 1 + u: (a0 - a1) + (a0 + a1) u."""
-    return (limb.sub_mod(ctx, a[0], a[1]), limb.add_mod(ctx, a[0], a[1]))
+    ra, rs = limb.addsub_mod_many(
+        ctx, [(a[0], a[1])], [(a[0], a[1])]
+    )
+    return (rs[0], ra[0])
 
 
 def fp2_conj(ctx, a):
     return (a[0], limb.neg_mod(ctx, a[1]))
+
+
+# -- stacked fp2 add/sub levels ---------------------------------------------
+# Group independent fp2 additions/subtractions into ONE stacked limb
+# normalize (see limb.add_mod_many): the tower's op count is dominated by
+# carry-resolution subgraphs, so emitting one per dependency LEVEL rather
+# than one per addition is the difference between compilable and
+# intractable pairing programs.
+
+
+def fp2_add_many(ctx, pairs):
+    flat = []
+    for a, b in pairs:
+        flat += [(a[0], b[0]), (a[1], b[1])]
+    res = limb.add_mod_many(ctx, flat)
+    return [(res[2 * i], res[2 * i + 1]) for i in range(len(pairs))]
+
+
+def fp2_sub_many(ctx, pairs):
+    flat = []
+    for a, b in pairs:
+        flat += [(a[0], b[0]), (a[1], b[1])]
+    res = limb.sub_mod_many(ctx, flat)
+    return [(res[2 * i], res[2 * i + 1]) for i in range(len(pairs))]
+
+
+def fp2_addsub_many(ctx, add_pairs, sub_pairs):
+    """Independent fp2 adds + subs in one stacked normalize."""
+    fa, fs = [], []
+    for a, b in add_pairs:
+        fa += [(a[0], b[0]), (a[1], b[1])]
+    for a, b in sub_pairs:
+        fs += [(a[0], b[0]), (a[1], b[1])]
+    ra, rs = limb.addsub_mod_many(ctx, fa, fs)
+    return (
+        [(ra[2 * i], ra[2 * i + 1]) for i in range(len(add_pairs))],
+        [(rs[2 * i], rs[2 * i + 1]) for i in range(len(sub_pairs))],
+    )
+
+
+def fp2_mul_xi_many(ctx, xs):
+    """xi * x for xi = 1 + u: (x0 - x1, x0 + x1), stacked."""
+    xs = list(xs)
+    adds = [(x[0], x[1]) for x in xs]
+    subs = [(x[0], x[1]) for x in xs]
+    ra, rs = limb.addsub_mod_many(ctx, adds, subs)
+    return [(rs[i], ra[i]) for i in range(len(xs))]
 
 
 def fp2_inv(ctx, a):
@@ -179,42 +215,76 @@ def fp2_batch(ctx, ops):
     All operands must share a batch shape. Returns the list of fp2 results
     in order.
     """
+    # prep level: every Karatsuba sum / squaring sum+difference in ONE
+    # stacked normalize
+    prep_adds, prep_subs = [], []
+    for op in ops:
+        if op[0] == "mul":
+            _, a, b = op
+            prep_adds += [(a[0], a[1]), (b[0], b[1])]
+        elif op[0] == "sqr":
+            _, a = op
+            prep_adds.append((a[0], a[1]))
+            prep_subs.append((a[0], a[1]))
+        elif op[0] != "mul_fp":
+            raise ValueError(op[0])
+    ra, rs = limb.addsub_mod_many(ctx, prep_adds, prep_subs)
+    ra, rs = iter(ra), iter(rs)
+
     xs, ys = [], []
     for op in ops:
         kind = op[0]
         if kind == "mul":
             _, a, b = op
-            xs += [a[0], a[1], limb.add_mod(ctx, a[0], a[1])]
-            ys += [b[0], b[1], limb.add_mod(ctx, b[0], b[1])]
+            xs += [a[0], a[1], next(ra)]
+            ys += [b[0], b[1], next(ra)]
         elif kind == "sqr":
             _, a = op
-            xs += [limb.add_mod(ctx, a[0], a[1]), a[0]]
-            ys += [limb.sub_mod(ctx, a[0], a[1]), a[1]]
-        elif kind == "mul_fp":
+            xs += [next(ra), a[0]]
+            ys += [next(rs), a[1]]
+        else:  # mul_fp
             _, a, s = op
             xs += [a[0], a[1]]
             ys += [s, s]
-        else:
-            raise ValueError(kind)
     prods = limb.mont_mul(ctx, jnp.stack(xs), jnp.stack(ys))
+
+    # post level A: v0+v1 per mul; post level B: the Karatsuba subs and
+    # squaring doubles — two stacked normalizes for the whole batch
+    a_adds = []
+    i = 0
+    for op in ops:
+        if op[0] == "mul":
+            a_adds.append((prods[i], prods[i + 1]))
+            i += 3
+        else:
+            i += 2
+    v01s = iter(limb.add_mod_many(ctx, a_adds) if a_adds else [])
+
+    b_adds, b_subs = [], []
+    i = 0
+    for op in ops:
+        if op[0] == "mul":
+            v0, v1, s = prods[i], prods[i + 1], prods[i + 2]
+            i += 3
+            b_subs += [(v0, v1), (s, next(v01s))]
+        elif op[0] == "sqr":
+            b_adds.append((prods[i + 1], prods[i + 1]))  # double
+            i += 2
+        else:
+            i += 2
+    rb_add, rb_sub = limb.addsub_mod_many(ctx, b_adds, b_subs)
+    rb_add, rb_sub = iter(rb_add), iter(rb_sub)
 
     out = []
     i = 0
     for op in ops:
         kind = op[0]
         if kind == "mul":
-            v0, v1, s = prods[i], prods[i + 1], prods[i + 2]
+            out.append((next(rb_sub), next(rb_sub)))
             i += 3
-            out.append(
-                (
-                    limb.sub_mod(ctx, v0, v1),
-                    limb.sub_mod(ctx, limb.sub_mod(ctx, s, v0), v1),
-                )
-            )
         elif kind == "sqr":
-            c0, p = prods[i], prods[i + 1]
+            out.append((prods[i], next(rb_add)))
             i += 2
-            out.append((c0, limb.double_mod(ctx, p)))
         else:  # mul_fp
             out.append((prods[i], prods[i + 1]))
             i += 2
@@ -243,15 +313,17 @@ def fp6_one(ctx, batch_shape=()):
 
 
 def fp6_add(ctx, a, b):
-    return tuple(fp2_add(ctx, x, y) for x, y in zip(a, b))
+    return tuple(fp2_add_many(ctx, list(zip(a, b))))
 
 
 def fp6_sub(ctx, a, b):
-    return tuple(fp2_sub(ctx, x, y) for x, y in zip(a, b))
+    return tuple(fp2_sub_many(ctx, list(zip(a, b))))
 
 
 def fp6_neg(ctx, a):
-    return tuple(fp2_neg(ctx, x) for x in a)
+    z = limb.zeros(ctx, a[0][0].shape[:-1])
+    r = limb.sub_mod_many(ctx, [(z, c) for x in a for c in x])
+    return ((r[0], r[1]), (r[2], r[3]), (r[4], r[5]))
 
 
 # The 9 cross products one fp6 school-book multiply needs, as (i, j) index
@@ -259,15 +331,40 @@ def fp6_neg(ctx, a):
 _FP6_PRODS = ((0, 0), (1, 1), (2, 2), (1, 2), (2, 1), (0, 1), (1, 0), (0, 2), (2, 0))
 
 
+def _fp6_combine_many(ctx, prod_groups):
+    """Assemble fp6 products from groups of 9 cross products (in
+    _FP6_PRODS order): c0 = p00 + xi(p12 + p21); c1 = p01 + p10 + xi p22;
+    c2 = p02 + p20 + p11 — all groups share two stacked add levels."""
+    # level 1: the pairwise sums (p12+p21), (p01+p10), (p02+p20) and the
+    # xi components of p22 for every group
+    l1_adds = []
+    for p00, p11, p22, p12, p21, p01, p10, p02, p20 in prod_groups:
+        l1_adds += [(p12, p21), (p01, p10), (p02, p20)]
+    l1 = iter(fp2_add_many(ctx, l1_adds))
+
+    # level 2: xi of the (p12+p21) sums and of p22 (xi is itself one
+    # add+sub level), then the final additions
+    xi_in = []
+    sums = []
+    for g in prod_groups:
+        s1221 = next(l1)
+        s0110 = next(l1)
+        s0220 = next(l1)
+        xi_in += [s1221, g[2]]  # xi(p12+p21), xi(p22)
+        sums.append((s0110, s0220))
+    xis = iter(fp2_mul_xi_many(ctx, xi_in))
+
+    l3_adds = []
+    for g, (s0110, s0220) in zip(prod_groups, sums):
+        xi1221 = next(xis)
+        xi22 = next(xis)
+        l3_adds += [(g[0], xi1221), (s0110, xi22), (s0220, g[1])]
+    l3 = iter(fp2_add_many(ctx, l3_adds))
+    return [tuple(next(l3) for _ in range(3)) for _ in prod_groups]
+
+
 def _fp6_combine(ctx, p):
-    """Assemble an fp6 product from the 9 cross products (in _FP6_PRODS
-    order): c0 = p00 + xi(p12 + p21); c1 = p01 + p10 + xi p22;
-    c2 = p02 + p20 + p11."""
-    p00, p11, p22, p12, p21, p01, p10, p02, p20 = p
-    c0 = fp2_add(ctx, p00, fp2_mul_xi(ctx, fp2_add(ctx, p12, p21)))
-    c1 = fp2_add(ctx, fp2_add(ctx, p01, p10), fp2_mul_xi(ctx, p22))
-    c2 = fp2_add(ctx, fp2_add(ctx, p02, p20), p11)
-    return (c0, c1, c2)
+    return _fp6_combine_many(ctx, [p])[0]
 
 
 def fp6_mul(ctx, a, b):
@@ -286,23 +383,30 @@ def fp6_mul_by_v(ctx, a):
 
 def fp6_inv(ctx, a):
     a0, a1, a2 = a
-    t0 = fp2_sub(ctx, fp2_sqr(ctx, a0), fp2_mul_xi(ctx, fp2_mul(ctx, a1, a2)))
-    t1 = fp2_sub(ctx, fp2_mul_xi(ctx, fp2_sqr(ctx, a2)), fp2_mul(ctx, a0, a1))
-    t2 = fp2_sub(ctx, fp2_sqr(ctx, a1), fp2_mul(ctx, a0, a2))
-    d = fp2_add(
+    # all six products in one stacked batch, then one xi level, one sub
+    # level, the d-assembly batch, and the final scaling batch
+    sq0, sq1, sq2, m12, m01, m02 = fp2_batch(
         ctx,
-        fp2_mul(ctx, a0, t0),
-        fp2_mul_xi(
-            ctx,
-            fp2_add(ctx, fp2_mul(ctx, a2, t1), fp2_mul(ctx, a1, t2)),
-        ),
+        [
+            ("sqr", a0),
+            ("sqr", a1),
+            ("sqr", a2),
+            ("mul", a1, a2),
+            ("mul", a0, a1),
+            ("mul", a0, a2),
+        ],
     )
+    x12, xsq2 = fp2_mul_xi_many(ctx, [m12, sq2])
+    t0, t1, t2 = fp2_sub_many(
+        ctx, [(sq0, x12), (xsq2, m01), (sq1, m02)]
+    )
+    p0, p1, p2 = fp2_mul_many(ctx, [(a0, t0), (a2, t1), (a1, t2)])
+    s12 = fp2_add(ctx, p1, p2)
+    (xs12,) = fp2_mul_xi_many(ctx, [s12])
+    d = fp2_add(ctx, p0, xs12)
     dinv = fp2_inv(ctx, d)
-    return (
-        fp2_mul(ctx, t0, dinv),
-        fp2_mul(ctx, t1, dinv),
-        fp2_mul(ctx, t2, dinv),
-    )
+    r = fp2_mul_many(ctx, [(t0, dinv), (t1, dinv), (t2, dinv)])
+    return (r[0], r[1], r[2])
 
 
 # ---------------------------------------------------------------------------
@@ -317,20 +421,32 @@ def fp12_one(ctx, batch_shape=()):
 def fp12_mul(ctx, a, b):
     """Karatsuba over Fp6 with all 27 fp2 cross products in ONE stacked
     base mul: t0 = a0 b0, t1 = a1 b1, t2 = (a0+a1)(b0+b1);
-    c0 = t0 + v t1, c1 = t2 - t0 - t1."""
+    c0 = t0 + v t1, c1 = t2 - t0 - t1. Every add/sub level is stacked."""
     a0, a1 = a
     b0, b1 = b
-    sa = fp6_add(ctx, a0, a1)
-    sb = fp6_add(ctx, b0, b1)
+    sums = iter(
+        fp2_add_many(
+            ctx, list(zip(a0, a1)) + list(zip(b0, b1))
+        )
+    )
+    sa = tuple(next(sums) for _ in range(3))
+    sb = tuple(next(sums) for _ in range(3))
     pairs = []
     for x, y in ((a0, b0), (a1, b1), (sa, sb)):
         pairs.extend((x[i], y[j]) for i, j in _FP6_PRODS)
     prods = fp2_mul_many(ctx, pairs)
-    t0 = _fp6_combine(ctx, prods[0:9])
-    t1 = _fp6_combine(ctx, prods[9:18])
-    t2 = _fp6_combine(ctx, prods[18:27])
-    c0 = fp6_add(ctx, t0, fp6_mul_by_v(ctx, t1))
-    c1 = fp6_sub(ctx, fp6_sub(ctx, t2, t0), t1)
+    t0, t1, t2 = _fp6_combine_many(
+        ctx, [prods[0:9], prods[9:18], prods[18:27]]
+    )
+    # c0 = t0 + v t1 (3 adds after the xi twist in mul_by_v);
+    # c1 = t2 - t0 - t1 (6 subs over two levels, folded to one via
+    # d = t2 - t0 then d - t1)
+    vt1 = fp6_mul_by_v(ctx, t1)
+    adds = list(zip(t0, vt1))
+    subs = list(zip(t2, t0))
+    ra, rs = fp2_addsub_many(ctx, adds, subs)
+    c0 = tuple(ra)
+    c1 = tuple(fp2_sub_many(ctx, list(zip(rs, t1))))
     return (c0, c1)
 
 
@@ -347,9 +463,23 @@ def fp12_conj(ctx, a):
 
 def fp12_inv(ctx, a):
     a0, a1 = a
-    d = fp6_sub(ctx, fp6_sqr(ctx, a0), fp6_mul_by_v(ctx, fp6_sqr(ctx, a1)))
+    # both fp6 squarings share one 18-product batch and one combine
+    prods = fp2_mul_many(
+        ctx,
+        [(a0[i], a0[j]) for i, j in _FP6_PRODS]
+        + [(a1[i], a1[j]) for i, j in _FP6_PRODS],
+    )
+    s0, s1 = _fp6_combine_many(ctx, [prods[:9], prods[9:]])
+    d = fp6_sub(ctx, s0, fp6_mul_by_v(ctx, s1))
     dinv = fp6_inv(ctx, d)
-    return (fp6_mul(ctx, a0, dinv), fp6_neg(ctx, fp6_mul(ctx, a1, dinv)))
+    # both output fp6 muls share one 18-product batch and one combine
+    prods2 = fp2_mul_many(
+        ctx,
+        [(a0[i], dinv[j]) for i, j in _FP6_PRODS]
+        + [(a1[i], dinv[j]) for i, j in _FP6_PRODS],
+    )
+    n0, n1 = _fp6_combine_many(ctx, [prods2[:9], prods2[9:]])
+    return (n0, fp6_neg(ctx, n1))
 
 
 def fp12_select(mask, a, b):
@@ -428,40 +558,48 @@ def fp12_cyclotomic_sqr(ctx, a):
     """
     (c0, c1, c2), (c3, c4, c5) = a
 
+    s40, s23, s51 = fp2_add_many(ctx, [(c4, c0), (c2, c3), (c5, c1)])
     sq = fp2_batch(
         ctx,
         [
             ("sqr", c4),
             ("sqr", c0),
-            ("sqr", fp2_add(ctx, c4, c0)),
+            ("sqr", s40),
             ("sqr", c2),
             ("sqr", c3),
-            ("sqr", fp2_add(ctx, c2, c3)),
+            ("sqr", s23),
             ("sqr", c5),
             ("sqr", c1),
-            ("sqr", fp2_add(ctx, c5, c1)),
+            ("sqr", s51),
         ],
     )
     t0, t1, t2, t3, t4, t5 = sq[0], sq[1], sq[3], sq[4], sq[6], sq[7]
-    t6 = fp2_sub(ctx, sq[2], fp2_add(ctx, t0, t1))  # 2 c0 c4
-    t7 = fp2_sub(ctx, sq[5], fp2_add(ctx, t2, t3))  # 2 c2 c3
-    t8 = fp2_mul_xi(
-        ctx, fp2_sub(ctx, sq[8], fp2_add(ctx, t4, t5))
-    )  # 2 c1 c5 xi
-    t0 = fp2_add(ctx, fp2_mul_xi(ctx, t0), t1)  # c0^2 + xi c4^2
-    t2 = fp2_add(ctx, fp2_mul_xi(ctx, t2), t3)
-    t4 = fp2_add(ctx, fp2_mul_xi(ctx, t4), t5)
-
-    def out_c0(t, c):  # 3t - 2c
-        return fp2_sub(ctx, fp2_small(ctx, t, 3), fp2_double(ctx, c))
-
-    def out_c1(t, c):  # 3t + 2c
-        return fp2_add(ctx, fp2_small(ctx, t, 3), fp2_double(ctx, c))
-
-    return (
-        (out_c0(t0, c0), out_c0(t2, c1), out_c0(t4, c2)),
-        (out_c1(t8, c3), out_c1(t6, c4), out_c1(t7, c5)),
+    # pairwise sums + xi twists, stacked
+    s01, s23b, s45 = fp2_add_many(ctx, [(t0, t1), (t2, t3), (t4, t5)])
+    xt0, xt2, xt4 = fp2_mul_xi_many(ctx, [t0, t2, t4])
+    adds, subs = fp2_addsub_many(
+        ctx,
+        [(xt0, t1), (xt2, t3), (xt4, t5)],  # xi t^2 + t'^2
+        [(sq[2], s01), (sq[5], s23b), (sq[8], s45)],  # the 2ab terms
     )
+    u0, u2, u4 = adds
+    t6, t7, t8pre = subs
+    (t8,) = fp2_mul_xi_many(ctx, [t8pre])
+
+    # outputs 3t ± 2c over three stacked levels (double, triple, combine)
+    ts = [u0, u2, u4, t8, t6, t7]
+    cs = [c0, c1, c2, c3, c4, c5]
+    doubles = fp2_add_many(
+        ctx, [(t, t) for t in ts] + [(c, c) for c in cs]
+    )
+    t2s, c2s = doubles[:6], doubles[6:]
+    t3s = fp2_add_many(ctx, list(zip(t2s, ts)))
+    adds2, subs2 = fp2_addsub_many(
+        ctx,
+        list(zip(t3s[3:], c2s[3:])),  # c1 row: 3t + 2c
+        list(zip(t3s[:3], c2s[:3])),  # c0 row: 3t - 2c
+    )
+    return (tuple(subs2), tuple(adds2))
 
 
 # ---------------------------------------------------------------------------

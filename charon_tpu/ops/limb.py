@@ -209,16 +209,19 @@ def _kogge_resolve(ctx: ModCtx, t):
     return out, gi[..., -1].astype(ctx.dtype)
 
 
-def _normalize(ctx: ModCtx, t):
+def _normalize(ctx: ModCtx, t, passes: int = 3):
     """Arbitrary accumulator-range limbs -> canonical form, (limbs, carry).
     `carry` is the total overflow out of the top limb (sum of the shift
     passes' dropped carries plus the final resolved carry) — callers doing
-    mod-2^(bits*width) arithmetic ignore it."""
-    t, c1 = _shift_carries(ctx, t)
-    t, c2 = _shift_carries(ctx, t)
-    t, c3 = _shift_carries(ctx, t)
-    out, c4 = _kogge_resolve(ctx, t)
-    return out, c1 + c2 + c3 + c4
+    mod-2^(bits*width) arithmetic ignore it. `passes` must take the input
+    down to < 2^(limb_bits+1) before the Kogge resolution: 3 covers full
+    accumulator range; 1 suffices for sums of a few canonical values."""
+    cs = []
+    for _ in range(passes):
+        t, c = _shift_carries(ctx, t)
+        cs.append(c)
+    out, c_final = _kogge_resolve(ctx, t)
+    return out, sum(cs) + c_final
 
 
 def _carry_pass(ctx: ModCtx, a):
@@ -227,12 +230,25 @@ def _carry_pass(ctx: ModCtx, a):
     return out
 
 
+@functools.lru_cache(maxsize=None)
+def _one_hot0(n_limbs: int, np_dtype) -> np.ndarray:
+    out = np.zeros(n_limbs, np_dtype)
+    out[0] = 1
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _r_minus_m(ctx: ModCtx) -> np.ndarray:
+    """R - modulus as limbs (R = 2^(limb_bits*n))."""
+    r = 1 << (ctx.limb_bits * ctx.n_limbs)
+    return int_to_limbs(r - ctx.modulus, ctx.n_limbs, ctx.limb_bits, ctx.np_dtype)
+
+
 def _sub_borrow(ctx: ModCtx, a, b):
     """(a - b) mod 2^(limb_bits*n) limbwise, plus the final borrow flag
     (1 if a < b). Implemented as a + ~b + 1 with parallel carries."""
     mask = ctx.u(ctx.mask)
-    z = a + (mask - b)
-    z = z.at[..., 0].add(ctx.u(1))
+    z = a + (mask - b) + jnp.asarray(_one_hot0(ctx.n_limbs, ctx.np_dtype))
     out, carry = _normalize(ctx, z)
     borrow = ctx.u(1) - carry  # carry-out 1 <=> a >= b
     return out, borrow
@@ -247,19 +263,111 @@ def _cond_sub(ctx: ModCtx, a):
 
 # ---------------------------------------------------------------------------
 # Modular add / sub / neg / select
+#
+# One stacked normalize per op: the raw result and its modulus-adjusted
+# twin are normalized together on a leading stack axis, then selected by
+# the twin's carry-out. Compared to normalize-then-conditionally-subtract
+# (two sequential normalizes), this halves the op count of the single
+# hottest subgraph in the whole engine — adds/subs outnumber multiplies
+# ~4:1 in the tower/pairing code. Precondition (asserted in make_ctx):
+# 2*modulus < R, so a+b never carries out of the top limb on its own.
 # ---------------------------------------------------------------------------
 
 
+def _add_many(ctx: ModCtx, pairs):
+    """Batched modular adds: one stacked normalize for any number of
+    independent (a, b) additions. Returns a list of canonical results."""
+    if not pairs:
+        return []
+    rm = jnp.asarray(_r_minus_m(ctx))
+    lanes = []
+    for a, b in pairs:
+        a, b = jnp.broadcast_arrays(a, b)
+        s = a + b
+        lanes.append(s)
+        lanes.append(s + rm)  # == a + b + (R - p): carries out iff a+b >= p
+    stacked = jnp.stack(jnp.broadcast_arrays(*lanes))
+    out, carry = _normalize(ctx, stacked, passes=1)
+    res = []
+    for i in range(len(pairs)):
+        raw, adj = out[2 * i], out[2 * i + 1]
+        res.append(jnp.where((carry[2 * i + 1] == 1)[..., None], adj, raw))
+    return res
+
+
+def _sub_many(ctx: ModCtx, pairs):
+    """Batched modular subs, one stacked normalize. For canonical a, b:
+    lane1 = a - b + R (carries iff a >= b), lane2 = a - b + p + R."""
+    if not pairs:
+        return []
+    mask = ctx.u(ctx.mask)
+    one0 = jnp.asarray(_one_hot0(ctx.n_limbs, ctx.np_dtype))
+    p = jnp.asarray(ctx.limbs)
+    lanes = []
+    for a, b in pairs:
+        a, b = jnp.broadcast_arrays(a, b)
+        z = a + (mask - b) + one0  # a - b + R limbwise (no borrows)
+        lanes.append(z)
+        lanes.append(z + p)
+    stacked = jnp.stack(jnp.broadcast_arrays(*lanes))
+    out, carry = _normalize(ctx, stacked, passes=1)
+    res = []
+    for i in range(len(pairs)):
+        raw, adj = out[2 * i], out[2 * i + 1]
+        # carry on the raw lane <=> a >= b <=> no +p needed
+        res.append(jnp.where((carry[2 * i] == 1)[..., None], raw, adj))
+    return res
+
+
 def add_mod(ctx: ModCtx, a, b):
-    return _cond_sub(ctx, _carry_pass(ctx, a + b))
+    return _add_many(ctx, [(a, b)])[0]
 
 
 def sub_mod(ctx: ModCtx, a, b):
-    a, b = jnp.broadcast_arrays(a, b)
-    d, borrow = _sub_borrow(ctx, a, b)
+    return _sub_many(ctx, [(a, b)])[0]
+
+
+def add_mod_many(ctx: ModCtx, pairs):
+    """Independent modular adds sharing ONE stacked normalize. The tower
+    code groups its adds by dependency level through this (and
+    sub_mod_many) — the main lever that keeps pairing programs compilable:
+    every emitted normalize is a Kogge-Stone subgraph, so op count scales
+    with dependency depth, not with the number of additions."""
+    return _add_many(ctx, list(pairs))
+
+
+def sub_mod_many(ctx: ModCtx, pairs):
+    return _sub_many(ctx, list(pairs))
+
+
+def addsub_mod_many(ctx: ModCtx, add_pairs, sub_pairs):
+    """Adds and subs together in ONE stacked normalize."""
+    add_pairs, sub_pairs = list(add_pairs), list(sub_pairs)
+    if not add_pairs and not sub_pairs:
+        return [], []
+    rm = jnp.asarray(_r_minus_m(ctx))
+    mask = ctx.u(ctx.mask)
+    one0 = jnp.asarray(_one_hot0(ctx.n_limbs, ctx.np_dtype))
     p = jnp.asarray(ctx.limbs)
-    d_plus_p = _carry_pass(ctx, d + p)  # wraps mod 2^(bits*n): == a - b + m
-    return jnp.where((borrow == 1)[..., None], d_plus_p, d)
+    lanes = []
+    for a, b in add_pairs:
+        a, b = jnp.broadcast_arrays(a, b)
+        s = a + b
+        lanes += [s, s + rm]
+    for a, b in sub_pairs:
+        a, b = jnp.broadcast_arrays(a, b)
+        z = a + (mask - b) + one0
+        lanes += [z, z + p]
+    out, carry = _normalize(ctx, jnp.stack(jnp.broadcast_arrays(*lanes)), passes=1)
+    res_add, res_sub = [], []
+    for i in range(len(add_pairs)):
+        raw, adj = out[2 * i], out[2 * i + 1]
+        res_add.append(jnp.where((carry[2 * i + 1] == 1)[..., None], adj, raw))
+    off = 2 * len(add_pairs)
+    for i in range(len(sub_pairs)):
+        raw, adj = out[off + 2 * i], out[off + 2 * i + 1]
+        res_sub.append(jnp.where((carry[off + 2 * i] == 1)[..., None], raw, adj))
+    return res_add, res_sub
 
 
 def neg_mod(ctx: ModCtx, a):
@@ -286,6 +394,15 @@ def select(mask, a, b):
 
 def zeros(ctx: ModCtx, batch_shape=()):
     return jnp.zeros((*batch_shape, ctx.n_limbs), ctx.dtype)
+
+
+def match_vary(arr, template):
+    """Give a constant-built limb array the same shard_map varying axes
+    as `template` (adds template * 0 — exact for unsigned limbs, folded
+    away by XLA). lax.scan under shard_map requires carry init and carry
+    output to agree on varying manual axes, so constant scan inits
+    (fp12_one, identity points) must inherit the inputs' axes."""
+    return arr + template * jnp.zeros((), template.dtype)
 
 
 def const(ctx: ModCtx, value: int, batch_shape=()):
@@ -369,8 +486,17 @@ def mont_mul(ctx: ModCtx, a, b):
     m = _conv_low(ctx, t[..., :n], jnp.asarray(ctx.ninv))
     m, _ = _normalize(ctx, m)  # mod R: top carry intentionally dropped
     s = t + _conv_full(ctx, m, jnp.asarray(ctx.limbs))
-    s, _ = _normalize(ctx, s)
-    return _cond_sub(ctx, s[..., n:])
+    # Final conditional subtract fused into the last normalize: lane2 adds
+    # (R - p) into the high columns, so its carry-out says hi >= p — one
+    # stacked normalize replaces normalize + cond_sub.
+    rm_hi = jnp.zeros(2 * n, ctx.np_dtype).at[n:].set(
+        jnp.asarray(_r_minus_m(ctx))
+    )
+    stacked = jnp.stack(jnp.broadcast_arrays(s, s + rm_hi))
+    out, carry = _normalize(ctx, stacked)
+    return jnp.where(
+        (carry[1] == 1)[..., None], out[1, ..., n:], out[0, ..., n:]
+    )
 
 
 def mont_sqr(ctx: ModCtx, a):

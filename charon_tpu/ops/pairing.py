@@ -50,10 +50,12 @@ X_BITS_FULL = np.array([int(b) for b in bin(X_ABS)[2:]], np.uint8)
 
 def fp12_mul_sparse_line(ctx, f, l0, l1, l2):
     """18 fp2 muls vs 36 for a dense fp12 mul (spec: pairing_fast.py:79) —
-    all independent, executed as ONE stacked base mul."""
+    all independent, executed as ONE stacked base mul; the combine runs in
+    three stacked add levels (sums, xi twists, final adds):
+        c0 = (p0 + xi(p7+p8), p1 + xi(p3+p4), p2 + p5 + xi p6)
+        c1 = (xi(p9+p10) + p15, p11 + xi p12 + p16, p13 + p14 + p17)
+    """
     (a0, a1, a2), (b0, b1, b2) = f
-    add = functools.partial(T.fp2_add, ctx)
-    xi = functools.partial(T.fp2_mul_xi, ctx)
 
     p = T.fp2_mul_many(
         ctx,
@@ -64,21 +66,32 @@ def fp12_mul_sparse_line(ctx, f, l0, l1, l2):
             (b0, l0), (b1, l0), (b2, l0),          # b*L0
         ],
     )
-    t0 = (p[0], p[1], p[2])
-    t1 = (
-        xi(add(p[3], p[4])),
-        add(p[5], xi(p[6])),
-        add(p[7], p[8]),
+    s78, s34, s910, s25, s1116, s1314 = T.fp2_add_many(
+        ctx,
+        [
+            (p[7], p[8]),
+            (p[3], p[4]),
+            (p[9], p[10]),
+            (p[2], p[5]),
+            (p[11], p[16]),
+            (p[13], p[14]),
+        ],
     )
-    c0 = (add(t0[0], xi(t1[2])), add(t0[1], t1[0]), add(t0[2], t1[1]))
-    a_l1 = (
-        xi(add(p[9], p[10])),
-        add(p[11], xi(p[12])),
-        add(p[13], p[14]),
+    x78, x34, x6, x910, x12 = T.fp2_mul_xi_many(
+        ctx, [s78, s34, p[6], s910, p[12]]
     )
-    b_l0 = (p[15], p[16], p[17])
-    c1 = tuple(add(x, y) for x, y in zip(a_l1, b_l0))
-    return (c0, c1)
+    c = T.fp2_add_many(
+        ctx,
+        [
+            (p[0], x78),
+            (p[1], x34),
+            (s25, x6),
+            (x910, p[15]),
+            (s1116, x12),
+            (s1314, p[17]),
+        ],
+    )
+    return ((c[0], c[1], c[2]), (c[3], c[4], c[5]))
 
 
 # ---------------------------------------------------------------------------
@@ -198,51 +211,63 @@ def miller_loop(ctx: ModCtx, pairs):
 
     p: affine G1 (x, y) Fp limb arrays; q: affine G2 (x, y) Fp2 elements.
     Affine (0, 0) lanes are identities and contribute 1.
-    """
-    batch_shape = pairs[0][0][0].shape[:-1]
-    dead = [
-        jnp.logical_and(limb.is_zero(p[0]), limb.is_zero(p[1]))
-        | jnp.logical_and(T.fp2_is_zero(q[0]), T.fp2_is_zero(q[1]))
-        for p, q in pairs
-    ]
 
-    # Initial T = (xq, yq, 1) per pair.
-    ts = tuple(
-        (q[0], q[1], T.fp2_one(ctx, batch_shape)) for _, q in pairs
+    Multiple pairs are STACKED onto one extra leading axis and run as
+    independent per-lane Miller loops, combined with a single fp12 mul at
+    the end (valid since the final exponentiation distributes over the
+    product). This keeps the scan body at ONE doubling step + ONE sparse
+    multiply regardless of len(pairs) — the body op count, not the
+    iteration count, is what dominates XLA compile time.
+    """
+    if len(pairs) > 1:
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(jnp.broadcast_arrays(*xs)), *pairs
+        )
+        lanes = miller_loop(ctx, [stacked])
+        f = jax.tree_util.tree_map(lambda a: a[0], lanes)
+        for i in range(1, len(pairs)):
+            f = T.fp12_mul(
+                ctx, f, jax.tree_util.tree_map(lambda a: a[i], lanes)
+            )
+        return f
+
+    ((p, q),) = pairs
+    batch_shape = p[0].shape[:-1]
+    dead = jnp.logical_and(limb.is_zero(p[0]), limb.is_zero(p[1])) | (
+        jnp.logical_and(T.fp2_is_zero(q[0]), T.fp2_is_zero(q[1]))
     )
-    f0 = T.fp12_one(ctx, batch_shape)
+
+    # constant scan-carry inits inherit the inputs' shard_map varying
+    # axes (see limb.match_vary)
+    vary = functools.partial(limb.match_vary, template=q[0][0])
+    t0 = (
+        q[0],
+        q[1],
+        jax.tree_util.tree_map(vary, T.fp2_one(ctx, batch_shape)),
+    )
+    f0 = jax.tree_util.tree_map(vary, T.fp12_one(ctx, batch_shape))
     bits = jnp.asarray(X_BITS)
 
-    def dbl_all(carry):
-        f, ts = carry
-        new_ts = []
-        for (p, _), t, d in zip(pairs, ts, dead):
-            t2, line = _dbl_step(ctx, t, p[0], p[1])
-            line = _mask_line(ctx, d, line, batch_shape)
-            f = fp12_mul_sparse_line(ctx, f, *line)
-            new_ts.append(t2)
-        return f, tuple(new_ts)
+    def dbl(carry):
+        f, t = carry
+        t2, line = _dbl_step(ctx, t, p[0], p[1])
+        line = _mask_line(ctx, dead, line, batch_shape)
+        return fp12_mul_sparse_line(ctx, f, *line), t2
 
-    def add_all(carry):
-        f, ts = carry
-        new_ts = []
-        for (p, q), t, d in zip(pairs, ts, dead):
-            t2, line = _add_step(ctx, t, q, p[0], p[1])
-            line = _mask_line(ctx, d, line, batch_shape)
-            f = fp12_mul_sparse_line(ctx, f, *line)
-            new_ts.append(t2)
-        return f, tuple(new_ts)
+    def add(carry):
+        f, t = carry
+        t2, line = _add_step(ctx, t, q, p[0], p[1])
+        line = _mask_line(ctx, dead, line, batch_shape)
+        return fp12_mul_sparse_line(ctx, f, *line), t2
 
     def step(carry, bit):
-        f, ts = carry
-        f = T.fp12_sqr(ctx, f)
-        f, ts = dbl_all((f, ts))
-        f, ts = lax.cond(bit != 0, add_all, lambda c: c, (f, ts))
-        return (f, ts), None
+        carry = dbl((T.fp12_sqr(ctx, carry[0]), carry[1]))
+        carry = lax.cond(bit != 0, add, lambda c: c, carry)
+        return carry, None
 
     # First schedule entry skips the squaring (f == 1 — squaring is a no-op,
     # so we just run the uniform step).
-    (f, _), _ = lax.scan(step, (f0, ts), bits)
+    (f, _), _ = lax.scan(step, (f0, t0), bits)
     if X_IS_NEG:
         f = T.fp12_conj(ctx, f)
     return f

@@ -1,0 +1,213 @@
+"""Operator tooling CLI: combine, exit sign/broadcast, test diagnostics
+(ref: cmd/combine, cmd/exit_sign.go, cmd/exit_broadcast.go, cmd/test.go).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.cmd import cli
+from charon_tpu.tbls.python_impl import PythonImpl
+
+
+@pytest.fixture(autouse=True)
+def host_tbls():
+    try:
+        from charon_tpu.tbls.native_impl import NativeImpl
+
+        tbls.set_implementation(NativeImpl())
+    except ImportError:
+        tbls.set_implementation(PythonImpl())
+    yield
+    tbls.set_implementation(PythonImpl())
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cluster")
+    assert (
+        cli.main(
+            [
+                "create-cluster",
+                "--name",
+                "tools-test",
+                "--nodes",
+                "4",
+                "--threshold",
+                "3",
+                "--validators",
+                "2",
+                "--output-dir",
+                str(out),
+            ]
+        )
+        == 0
+    )
+    return out
+
+
+def test_combine_recovers_group_keys(cluster, tmp_path):
+    out = tmp_path / "combined"
+    assert (
+        cli.main(
+            [
+                "combine",
+                "--cluster-dir",
+                str(cluster),
+                "--output-dir",
+                str(out),
+            ]
+        )
+        == 0
+    )
+    from charon_tpu.cluster.lock import ClusterLock
+    from charon_tpu.eth2util import keystore
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    secrets = keystore.load_keys(out)
+    assert len(secrets) == 2
+    for vi, secret in enumerate(secrets):
+        assert (
+            "0x" + tbls.secret_to_public_key(secret).hex()
+            == lock.validators[vi].distributed_public_key
+        )
+    # the recovered key signs verifiably under the group pubkey
+    sig = tbls.sign(secrets[0], b"combine-proof")
+    tbls.verify(
+        bytes.fromhex(lock.validators[0].distributed_public_key[2:]),
+        b"combine-proof",
+        sig,
+    )
+
+
+def test_combine_insufficient_shares_fails(cluster, tmp_path):
+    import shutil
+
+    partial = tmp_path / "partial-cluster"
+    partial.mkdir()
+    for i in range(2):  # only 2 of threshold-3 node dirs
+        shutil.copytree(cluster / f"node{i}", partial / f"node{i}")
+    assert (
+        cli.main(
+            [
+                "combine",
+                "--cluster-dir",
+                str(partial),
+                "--output-dir",
+                str(tmp_path / "nope"),
+            ]
+        )
+        == 1
+    )
+
+
+def test_exit_sign_and_broadcast(cluster, tmp_path):
+    # three nodes sign partial exits for validator 0
+    partials = []
+    for i in range(3):
+        out = tmp_path / f"partial-{i}.json"
+        assert (
+            cli.main(
+                [
+                    "exit",
+                    "sign",
+                    "--data-dir",
+                    str(cluster / f"node{i}"),
+                    "--validator-index",
+                    "0",
+                    "--epoch",
+                    "1234",
+                    "--output",
+                    str(out),
+                ]
+            )
+            == 0
+        )
+        partials.append(str(out))
+        data = json.loads(out.read_text())
+        assert data["share_idx"] == i + 1
+
+    signed_path = tmp_path / "exit.json"
+    assert (
+        cli.main(
+            [
+                "exit",
+                "broadcast",
+                "--data-dir",
+                str(cluster / "node0"),
+                "--partials",
+                *partials,
+                "--output",
+                str(signed_path),
+            ]
+        )
+        == 0
+    )
+    signed = json.loads(signed_path.read_text())
+    assert signed["message"] == {"epoch": "1234", "validator_index": "0"}
+
+    # the aggregate signature verifies against the group key + exit domain
+    from charon_tpu.cluster.lock import ClusterLock
+    from charon_tpu.core.eth2data import SignedData, VoluntaryExit
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    fork = lock.fork_info()
+    root = SignedData("exit", VoluntaryExit(1234, 0)).signing_root(fork, 1234)
+    tbls.verify(
+        bytes.fromhex(lock.validators[0].distributed_public_key[2:]),
+        root,
+        bytes.fromhex(signed["signature"][2:]),
+    )
+
+
+def test_exit_broadcast_too_few_partials(cluster, tmp_path):
+    out = tmp_path / "p0.json"
+    cli.main(
+        [
+            "exit", "sign",
+            "--data-dir", str(cluster / "node0"),
+            "--validator-index", "0",
+            "--epoch", "99",
+            "--output", str(out),
+        ]
+    )
+    assert (
+        cli.main(
+            [
+                "exit", "broadcast",
+                "--data-dir", str(cluster / "node0"),
+                "--partials", str(out),
+                "--output", str(tmp_path / "nope.json"),
+            ]
+        )
+        == 1
+    )
+
+
+def test_test_peers_diagnostics(capsys):
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    try:
+        rc = cli.main(
+            ["test", "peers", "--peers", f"127.0.0.1:{port}", "--count", "2"]
+        )
+    finally:
+        srv.close()
+    assert rc == 0
+    assert "median=" in capsys.readouterr().out
+
+
+def test_test_peers_unreachable(capsys):
+    rc = cli.main(
+        ["test", "peers", "--peers", "127.0.0.1:1", "--count", "1"]
+    )
+    assert rc == 1
+    assert "unreachable" in capsys.readouterr().out

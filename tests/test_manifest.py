@@ -1,0 +1,179 @@
+"""Cluster manifest mutation-DAG (ref: cluster/manifest/materialise.go,
+mutationaddvalidator.go, mutationnodeapproval.go) + the solo
+add-validators CLI flow (ref: cmd/addvalidators.go).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.cluster.lock import DistributedValidator
+from charon_tpu.cluster.manifest import (
+    Manifest,
+    SignedMutation,
+    load_cluster_state,
+)
+from charon_tpu.cmd import cli
+from charon_tpu.tbls.python_impl import PythonImpl
+
+
+@pytest.fixture(autouse=True)
+def host_tbls():
+    try:
+        from charon_tpu.tbls.native_impl import NativeImpl
+
+        tbls.set_implementation(NativeImpl())
+    except ImportError:
+        tbls.set_implementation(PythonImpl())
+    yield
+    tbls.set_implementation(PythonImpl())
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cluster")
+    assert (
+        cli.main(
+            [
+                "create-cluster",
+                "--nodes",
+                "4",
+                "--threshold",
+                "3",
+                "--validators",
+                "1",
+                "--output-dir",
+                str(out),
+            ]
+        )
+        == 0
+    )
+    return out
+
+
+def _new_validator(i: int) -> DistributedValidator:
+    return DistributedValidator(
+        distributed_public_key="0x" + (bytes([i]) * 48).hex(),
+        public_shares=tuple("0x" + (bytes([i, j]) * 24).hex() for j in range(4)),
+    )
+
+
+def test_genesis_materialises_to_lock(cluster):
+    from charon_tpu.cluster.lock import ClusterLock
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    manifest = Manifest.genesis(lock)
+    state = manifest.materialise()
+    assert state.lock_hash() == lock.lock_hash()
+    assert state.validators == lock.validators
+
+
+def test_add_validators_requires_all_approvals(cluster):
+    from charon_tpu.cluster.lock import ClusterLock
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    keys = [
+        cli._load_node_key(cluster / f"node{i}") for i in range(4)
+    ]
+    manifest = Manifest.genesis(lock)
+    mutation = manifest.propose_add_validators([_new_validator(7)])
+    manifest = manifest.append(mutation)
+
+    # partial approvals: validator NOT yet added
+    for key in keys[:3]:
+        manifest = manifest.append(manifest.approve(mutation.hash(), key))
+    assert len(manifest.materialise().validators) == 1
+
+    # final approval: added
+    manifest = manifest.append(manifest.approve(mutation.hash(), keys[3]))
+    state = manifest.materialise()
+    assert len(state.validators) == 2
+    assert state.validators[1].distributed_public_key == "0x" + (bytes([7]) * 48).hex()
+
+
+def test_non_operator_approval_rejected(cluster):
+    from charon_tpu.app import k1util
+    from charon_tpu.cluster.lock import ClusterLock
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    manifest = Manifest.genesis(lock)
+    mutation = manifest.propose_add_validators([_new_validator(9)])
+    manifest = manifest.append(mutation)
+    stranger = k1util.generate_private_key()
+    manifest = manifest.append(manifest.approve(mutation.hash(), stranger))
+    with pytest.raises(ValueError, match="non-operator"):
+        manifest.materialise()
+
+
+def test_broken_chain_rejected(cluster):
+    from charon_tpu.cluster.lock import ClusterLock
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    manifest = Manifest.genesis(lock)
+    orphan = SignedMutation(
+        parent=b"\x13" * 32,
+        type="add_validators",
+        timestamp=0,
+        data={"validators": []},
+    )
+    with pytest.raises(ValueError, match="parent"):
+        manifest.append(orphan)
+    # force it in and materialise must also reject
+    bad = Manifest(mutations=manifest.mutations + (orphan,))
+    with pytest.raises(ValueError, match="chain"):
+        bad.materialise()
+
+
+def test_manifest_json_roundtrip(cluster, tmp_path):
+    from charon_tpu.cluster.lock import ClusterLock
+
+    lock = ClusterLock.load(str(cluster / "node0" / "cluster-lock.json"))
+    keys = [cli._load_node_key(cluster / f"node{i}") for i in range(4)]
+    manifest = Manifest.genesis(lock)
+    mutation = manifest.propose_add_validators([_new_validator(5)])
+    manifest = manifest.append(mutation)
+    for key in keys:
+        manifest = manifest.append(manifest.approve(mutation.hash(), key))
+    path = tmp_path / "cluster-manifest.json"
+    manifest.save(str(path))
+    loaded = Manifest.load(str(path))
+    assert loaded.head() == manifest.head()
+    assert len(loaded.materialise().validators) == 2
+
+
+def test_alpha_add_validators_cli(cluster):
+    from charon_tpu.eth2util import keystore
+
+    assert (
+        cli.main(
+            [
+                "alpha",
+                "add-validators",
+                "--cluster-dir",
+                str(cluster),
+                "--count",
+                "1",
+            ]
+        )
+        == 0
+    )
+    # every node has the manifest and an appended keystore
+    for i in range(4):
+        d = cluster / f"node{i}"
+        state = load_cluster_state(d)
+        assert len(state.validators) == 2
+        secrets = keystore.load_keys(d / "validator_keys")
+        assert len(secrets) == 2
+
+    # the new validator's share keys recombine to its group key
+    shares = {}
+    state = load_cluster_state(cluster / "node0")
+    for i in range(4):
+        secrets = keystore.load_keys(cluster / f"node{i}" / "validator_keys")
+        shares[i + 1] = secrets[1]
+    secret = tbls.recover_secret(dict(list(shares.items())[:3]), 4, 3)
+    assert (
+        "0x" + tbls.secret_to_public_key(secret).hex()
+        == state.validators[1].distributed_public_key
+    )

@@ -19,22 +19,11 @@ from pathlib import Path
 import pytest
 
 from charon_tpu.cmd import cli
+from charon_tpu.testutil.compose import _free_ports
 
 REPO = Path(__file__).resolve().parent.parent
 
 N = 4
-
-
-def _free_ports(n: int) -> list[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 @pytest.mark.slow
@@ -128,6 +117,13 @@ def test_networked_dkg_multiprocess(tmp_path):
     for d in dirs:
         keys = list((d / "validator_keys").glob("keystore-*.json"))
         assert len(keys) == 1
+
+    # deposit-data.json: identical across nodes, launchpad shape
+    deposits = [
+        json.loads((d / "deposit-data.json").read_text()) for d in dirs
+    ]
+    assert all(dd == deposits[0] for dd in deposits[1:])
+    assert deposits[0][0]["deposit_data_root"]
 
     # 5. the lock verifies: aggregate signature + every node signature
     from charon_tpu.app import k1util as k1

@@ -1,0 +1,112 @@
+"""Workflow tracing (ref: app/tracer/trace.go, core/tracing.go)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from charon_tpu.app import tracer
+from charon_tpu.core.types import Duty, DutyType
+
+
+def test_span_nesting_and_trace_propagation():
+    t = tracer.Tracer()
+    duty = Duty(slot=7, type=DutyType.ATTESTER)
+    with tracer.span("outer", duty=duty, tracer=t) as outer:
+        with tracer.span("inner", tracer=t) as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = t.dump()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    assert all(s["duration_us"] >= 0 for s in spans)
+
+
+def test_duty_trace_id_deterministic_across_nodes():
+    duty = Duty(slot=42, type=DutyType.PROPOSER)
+    assert tracer.duty_trace_id(duty) == tracer.duty_trace_id(
+        Duty(slot=42, type=DutyType.PROPOSER)
+    )
+    assert tracer.duty_trace_id(duty) != tracer.duty_trace_id(
+        Duty(slot=43, type=DutyType.PROPOSER)
+    )
+
+
+def test_error_spans_marked():
+    t = tracer.Tracer()
+    with pytest.raises(ValueError):
+        with tracer.span("boom", tracer=t):
+            raise ValueError("nope")
+    (s,) = t.dump()
+    assert s["status"] == "error"
+    assert "ValueError" in s["attrs"]["error"]
+
+
+def test_tracing_wire_option_records_edges():
+    t = tracer.Tracer()
+    duty = Duty(slot=3, type=DutyType.ATTESTER)
+
+    async def run():
+        async def fetch(d, defs):
+            return "fetched"
+
+        wrapped = tracer.tracing(t)("fetcher.fetch", fetch)
+        assert await wrapped(duty, {}) == "fetched"
+
+    asyncio.run(run())
+    (s,) = t.dump()
+    assert s["name"] == "fetcher.fetch"
+    assert s["trace_id"] == tracer.duty_trace_id(duty)
+    assert s["attrs"]["duty"] == str(duty)
+
+
+def test_jsonl_export(tmp_path):
+    import json
+
+    path = tmp_path / "traces.jsonl"
+    t = tracer.Tracer(jsonl_path=str(path))
+    with tracer.span("exported", tracer=t):
+        pass
+    t.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines and lines[0]["name"] == "exported"
+
+
+def test_debug_traces_endpoint():
+    import json
+    import urllib.request
+
+    from charon_tpu.app.metrics import ClusterMetrics, serve_monitoring
+
+    async def run():
+        t = tracer.Tracer()
+        tracer.set_global_tracer(t)
+        duty = Duty(slot=9, type=DutyType.ATTESTER)
+        with tracer.span("edge", duty=duty, tracer=t):
+            pass
+        metrics = ClusterMetrics("0xdead", "test", "node0")
+        server = await serve_monitoring("127.0.0.1", 0, metrics)
+        port = server.sockets[0].getsockname()[1]
+
+        def get(url):
+            with urllib.request.urlopen(url) as resp:
+                return json.loads(resp.read())
+
+        spans = await asyncio.to_thread(
+            get, f"http://127.0.0.1:{port}/debug/traces"
+        )
+        assert spans and spans[0]["name"] == "edge"
+        filt = await asyncio.to_thread(
+            get,
+            f"http://127.0.0.1:{port}/debug/traces?trace_id="
+            + tracer.duty_trace_id(duty),
+        )
+        assert len(filt) == 1
+        none = await asyncio.to_thread(
+            get, f"http://127.0.0.1:{port}/debug/traces?trace_id=" + "0" * 32
+        )
+        assert none == []
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(run())
