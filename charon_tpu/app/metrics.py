@@ -140,9 +140,10 @@ async def serve_monitoring(
 
                 from charon_tpu.app import tracer as _tracer
 
-                trace_id = None
-                if "?trace_id=" in path:
-                    trace_id = path.split("?trace_id=")[1].split("&")[0]
+                from urllib.parse import parse_qs, urlsplit
+
+                query = parse_qs(urlsplit(path).query)
+                trace_id = (query.get("trace_id") or [None])[0]
                 body = _json.dumps(
                     _tracer.global_tracer().dump(trace_id)
                 ).encode()

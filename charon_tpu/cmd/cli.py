@@ -510,7 +510,7 @@ def cmd_exit(args) -> int:
         # ref: cmd/exit_sign.go — one partial exit signed with this
         # node's share key
         vi = args.validator_index
-        if vi >= len(lock.validators):
+        if not 0 <= vi < len(lock.validators):
             print("validator index out of range", file=sys.stderr)
             return 1
         dv = lock.validators[vi]

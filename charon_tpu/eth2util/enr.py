@@ -121,17 +121,26 @@ def pubkey_from_string(text: str) -> bytes:
     """Operator record -> 33-byte compressed secp256k1 pubkey.
 
     Accepts real EIP-778 records and (for artifacts created before real
-    ENRs landed) the legacy `enr:...:<hex-pubkey>` stand-in format."""
+    ENRs landed) the legacy `enr:...:<hex-pubkey>` stand-in format. A
+    structurally valid record that fails signature verification is an
+    ERROR, not a fallback case — falling back would hide tampering."""
+    parse_exc = None
     if text.startswith("enr:"):
         try:
             return parse(text).pubkey
-        except Exception:
-            pass  # fall through to legacy format
-    hexpart = text.split(":")[-1]
-    pk = bytes.fromhex(hexpart)
-    if len(pk) != 33:
-        raise ValueError(f"cannot extract operator pubkey from {text!r}")
-    return pk
+        except ValueError as e:
+            if "signature" in str(e):
+                raise  # tampered record: never fall back
+            parse_exc = e  # structurally not a record: try legacy
+    try:
+        pk = bytes.fromhex(text.split(":")[-1])
+        if len(pk) == 33:
+            return pk
+    except ValueError:
+        pass
+    raise ValueError(
+        f"cannot extract operator pubkey from {text!r}"
+    ) from parse_exc
 
 
 def parse(text: str) -> Record:

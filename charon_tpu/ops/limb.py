@@ -244,21 +244,6 @@ def _r_minus_m(ctx: ModCtx) -> np.ndarray:
     return int_to_limbs(r - ctx.modulus, ctx.n_limbs, ctx.limb_bits, ctx.np_dtype)
 
 
-def _sub_borrow(ctx: ModCtx, a, b):
-    """(a - b) mod 2^(limb_bits*n) limbwise, plus the final borrow flag
-    (1 if a < b). Implemented as a + ~b + 1 with parallel carries."""
-    mask = ctx.u(ctx.mask)
-    z = a + (mask - b) + jnp.asarray(_one_hot0(ctx.n_limbs, ctx.np_dtype))
-    out, carry = _normalize(ctx, z)
-    borrow = ctx.u(1) - carry  # carry-out 1 <=> a >= b
-    return out, borrow
-
-
-def _cond_sub(ctx: ModCtx, a):
-    """a - m if a >= m else a, for normalized a < 2m."""
-    p = jnp.asarray(ctx.limbs)
-    d, borrow = _sub_borrow(ctx, a, jnp.broadcast_to(p, a.shape))
-    return jnp.where((borrow == 0)[..., None], d, a)
 
 
 # ---------------------------------------------------------------------------
@@ -465,6 +450,26 @@ def _conv_low(ctx: ModCtx, a, b):
     return _conv(ctx, a, b, ctx.n_limbs)
 
 
+# Pallas kernel dispatch: None = auto (on for the uint32 geometry when
+# the default backend is a real TPU), True/False = forced. The fused
+# kernel keeps the whole multiply in VMEM — the jnp path's band-matrix
+# intermediates make it HBM-bound (see ops/pallas_mont.py).
+_PALLAS_MODE: bool | None = None
+
+
+def set_pallas(mode: bool | None) -> None:
+    global _PALLAS_MODE
+    _PALLAS_MODE = mode
+
+
+def _pallas_active(ctx: ModCtx) -> bool:
+    if ctx.np_dtype is not np.uint32:
+        return False
+    if _PALLAS_MODE is not None:
+        return _PALLAS_MODE
+    return _is_tpu_backend()
+
+
 def mont_mul(ctx: ModCtx, a, b):
     """a * b * R^-1 mod m for reduced Montgomery-form inputs.
 
@@ -479,6 +484,10 @@ def mont_mul(ctx: ModCtx, a, b):
     Three convolutions + parallel carry normalization replace the n-round
     scan: ~10x fewer XLA ops and no serialization on the limb axis.
     """
+    if _pallas_active(ctx):
+        from charon_tpu.ops.pallas_mont import mont_mul_pallas
+
+        return mont_mul_pallas(ctx, a, b)
     a, b = jnp.broadcast_arrays(a, b)
     n = ctx.n_limbs
     t = _conv_full(ctx, a, b)

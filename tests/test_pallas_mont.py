@@ -1,0 +1,90 @@
+"""Fused Pallas Montgomery multiply vs the jnp engine and host oracle
+(interpret mode on CPU; the same kernel runs compiled on the TPU —
+ops/pallas_mont.py).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from charon_tpu.ops import limb
+from charon_tpu.ops.pallas_mont import mont_mul_pallas
+
+
+@pytest.mark.parametrize("ctx", [limb.FP32, limb.FR32], ids=["fp32", "fr32"])
+def test_pallas_matches_jnp_and_host(ctx):
+    rng = random.Random(11)
+    vals_a = [rng.randrange(ctx.modulus) for _ in range(8)]
+    vals_b = [rng.randrange(ctx.modulus) for _ in range(8)]
+    a = jnp.asarray(limb.pack_mont_host(ctx, vals_a))
+    b = jnp.asarray(limb.pack_mont_host(ctx, vals_b))
+
+    got = mont_mul_pallas(ctx, a, b, interpret=True)
+    want = limb.mont_mul(ctx, a, b)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    # host oracle: abR^-1 mod p
+    rinv = pow(ctx.r_mont, -1, ctx.modulus)
+    host = [
+        va * vb % ctx.modulus * rinv % ctx.modulus
+        for va, vb in zip(vals_a, vals_b)
+    ]
+    assert limb.unpack_mont_host(ctx, got) == [
+        va * vb % ctx.modulus for va, vb in zip(vals_a, vals_b)
+    ] or limb.ctx_unpack(ctx, got) == [
+        v * ctx.r_mont % ctx.modulus for v in host
+    ]
+
+
+@pytest.mark.parametrize("ctx", [limb.FP32, limb.FR32], ids=["fp32", "fr32"])
+def test_pallas_edge_values(ctx):
+    edge = [0, 1, 2, ctx.modulus - 1, ctx.modulus - 2, ctx.modulus // 2]
+    a = jnp.asarray(limb.pack_mont_host(ctx, edge))
+    b = jnp.asarray(limb.pack_mont_host(ctx, list(reversed(edge))))
+    got = mont_mul_pallas(ctx, a, b, interpret=True)
+    want = limb.mont_mul(ctx, a, b)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_batch_shapes():
+    """Leading batch dims and pad/unpad round the TILE boundary."""
+    ctx = limb.FP32
+    rng = random.Random(12)
+    vals = [rng.randrange(ctx.modulus) for _ in range(6)]
+    flat = jnp.asarray(limb.pack_mont_host(ctx, vals))
+    a = flat.reshape(2, 3, ctx.n_limbs)
+    b = flat.reshape(2, 3, ctx.n_limbs)[::-1]
+    got = mont_mul_pallas(ctx, a, b, interpret=True)
+    want = limb.mont_mul(ctx, a, b)
+    assert got.shape == (2, 3, ctx.n_limbs)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_multi_chunk_lax_map():
+    """Batches beyond one TILE run the kernel under lax.map."""
+    from charon_tpu.ops.pallas_mont import TILE
+
+    ctx = limb.FR32  # 22 limbs: cheaper interpret run
+    rng = random.Random(13)
+    rows = TILE + 5
+    vals_a = [rng.randrange(ctx.modulus) for _ in range(rows)]
+    vals_b = [rng.randrange(ctx.modulus) for _ in range(rows)]
+    a = jnp.asarray(limb.pack_mont_host(ctx, vals_a))
+    b = jnp.asarray(limb.pack_mont_host(ctx, vals_b))
+    got = mont_mul_pallas(ctx, a, b, interpret=True)
+    want = limb.mont_mul(ctx, a, b)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_rejects_u64_geometry():
+    with pytest.raises(ValueError):
+        mont_mul_pallas(
+            limb.FP,
+            jnp.zeros((4, 16), jnp.uint64),
+            jnp.zeros((4, 16), jnp.uint64),
+            interpret=True,
+        )
