@@ -99,20 +99,45 @@ def main() -> None:
 
     kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
 
-    # tiny warmup shape first: proves the pipeline + persists its kernel
+    # tiny warmup shape first: proves the pipeline + persists its kernel.
+    # If the Pallas fast path misbehaves on this platform (compiler or
+    # numeric), fall back to the pure-XLA engine rather than reporting
+    # nothing.
     wp, wm, ws = pack(WARMUP_BATCH)
-    t = time.perf_counter()
-    ok = kernel(wp, wm, ws)
-    ok.block_until_ready()
-    hb(f"warmup batch={WARMUP_BATCH} compile+run {time.perf_counter() - t:.1f}s ok={bool(ok.all())}")
-    assert bool(ok.all()), "warmup verification failed"
+    try:
+        t = time.perf_counter()
+        ok = kernel(wp, wm, ws)
+        ok.block_until_ready()
+        assert bool(ok.all()), "warmup verification failed"
+        hb(f"warmup batch={WARMUP_BATCH} compile+run {time.perf_counter() - t:.1f}s ok=True")
+    except Exception as e:
+        hb(f"fast path failed ({type(e).__name__}: {str(e)[:120]}); retrying with pallas disabled")
+        limb.set_pallas(False)
+        kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
+        t = time.perf_counter()
+        ok = kernel(wp, wm, ws)
+        ok.block_until_ready()
+        assert bool(ok.all()), "warmup verification failed (fallback)"
+        hb(f"fallback warmup compile+run {time.perf_counter() - t:.1f}s ok=True")
 
     pk, msg, sig = pack(BATCH)
-    t = time.perf_counter()
-    ok = kernel(pk, msg, sig)
-    ok.block_until_ready()
-    hb(f"main batch={BATCH} compile+run {time.perf_counter() - t:.1f}s")
-    assert bool(ok.all()), "bench workload failed verification"
+    try:
+        t = time.perf_counter()
+        ok = kernel(pk, msg, sig)
+        ok.block_until_ready()
+        hb(f"main batch={BATCH} compile+run {time.perf_counter() - t:.1f}s")
+        assert bool(ok.all()), "bench workload failed verification"
+    except Exception as e:
+        # shape-dependent failure at the big batch (fast path or the
+        # platform's compiler): disable pallas and retry once
+        hb(f"main batch failed ({type(e).__name__}: {str(e)[:120]}); retry without pallas")
+        limb.set_pallas(False)
+        kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
+        t = time.perf_counter()
+        ok = kernel(pk, msg, sig)
+        ok.block_until_ready()
+        hb(f"fallback main batch compile+run {time.perf_counter() - t:.1f}s")
+        assert bool(ok.all()), "bench workload failed verification (fallback)"
 
     times = []
     for i in range(ITERS):

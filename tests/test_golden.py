@@ -1,0 +1,106 @@
+"""Golden-file pinning of consensus-critical serializations
+(ref: testutil/golden.go + per-package testdata/ usage): definition/lock
+hashing, SSZ roots, ENR encoding, p2p wire envelopes. Any unintended
+format change breaks these — exactly the drift that would fork a cluster.
+"""
+
+from __future__ import annotations
+
+from charon_tpu.app import k1util
+from charon_tpu.cluster.definition import ClusterDefinition, Operator
+from charon_tpu.core.eth2data import (
+    AttestationData,
+    Attestation,
+    Checkpoint,
+    SignedData,
+)
+from charon_tpu.eth2util import enr
+from charon_tpu.eth2util.signing import ForkInfo
+from charon_tpu.testutil.golden import require_golden_bytes, require_golden_json
+
+# deterministic key for record/signing goldens
+_KEY = k1util.private_key_from_bytes(b"\x11" * 32)
+
+
+def _defn() -> ClusterDefinition:
+    return ClusterDefinition(
+        name="golden",
+        num_validators=2,
+        threshold=3,
+        fork_version="0x00000000",
+        operators=tuple(
+            Operator(address=f"op-{i}", enr=f"enr:legacy:{'%02x' % i * 33}")
+            for i in range(4)
+        ),
+        uuid="00000000-0000-0000-0000-000000000000",
+        timestamp="2026-01-01T00:00:00Z",
+    )
+
+
+def test_definition_hashes_golden():
+    d = _defn()
+    require_golden_json(
+        __file__,
+        "definition_hashes.json",
+        {
+            "config_hash": "0x" + d.config_hash().hex(),
+            "definition_hash": "0x" + d.definition_hash().hex(),
+            "eip712_config_digest": "0x" + d.config_signature_digest().hex(),
+        },
+    )
+
+
+def test_attestation_ssz_root_golden():
+    att = Attestation(
+        aggregation_bits=(True, False, True),
+        data=AttestationData(
+            slot=123,
+            index=4,
+            beacon_block_root=b"\x0a" * 32,
+            source=Checkpoint(3, b"\x0b" * 32),
+            target=Checkpoint(4, b"\x0c" * 32),
+        ),
+        signature=b"\x0d" * 96,
+    )
+    fork = ForkInfo(
+        genesis_validators_root=b"\x42" * 32,
+        fork_version=b"\x00\x00\x00\x00",
+        genesis_fork_version=b"\x00\x00\x00\x00",
+    )
+    require_golden_json(
+        __file__,
+        "attestation_roots.json",
+        {
+            "hash_tree_root": att.hash_tree_root().hex(),
+            "signing_root": SignedData("attestation", att)
+            .signing_root(fork, 123 // 32)
+            .hex(),
+        },
+    )
+
+
+def test_enr_encoding_golden():
+    rec = enr.new(_KEY, seq=1, ip="10.0.0.1", tcp=3610)
+    # signature is deterministic? ECDSA here is RFC6979-style via
+    # cryptography? NOT guaranteed deterministic — pin the unsigned
+    # content + digest instead of the full record.
+    require_golden_json(
+        __file__,
+        "enr_content.json",
+        {
+            "signing_digest": rec.signing_digest().hex(),
+            "kvs": [[k.hex(), v.hex()] for k, v in rec.kvs],
+        },
+    )
+    # round-trip stability of the textual form
+    assert enr.parse(rec.to_string()).signing_digest() == rec.signing_digest()
+
+
+def test_wire_envelope_golden():
+    from charon_tpu.p2p import codec
+    from charon_tpu.core.types import Duty, DutyType
+
+    payload = codec.encode(
+        {"duty": str(Duty(slot=9, type=DutyType.ATTESTER)), "x": 1}
+    )
+    require_golden_bytes(__file__, "wire_envelope.bin", payload)
