@@ -97,47 +97,39 @@ def main() -> None:
         sig = C.g2_pack(ctx, [g2_from_bytes(s) for s in sigs[:npack]])
         return pk, msg, sig
 
-    kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
+    state = {"kernel": jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s)),
+             "fallback": False}
 
-    # tiny warmup shape first: proves the pipeline + persists its kernel.
-    # If the Pallas fast path misbehaves on this platform (compiler or
-    # numeric), fall back to the pure-XLA engine rather than reporting
-    # nothing.
-    wp, wm, ws = pack(WARMUP_BATCH)
-    try:
-        t = time.perf_counter()
-        ok = kernel(wp, wm, ws)
-        ok.block_until_ready()
-        assert bool(ok.all()), "warmup verification failed"
-        hb(f"warmup batch={WARMUP_BATCH} compile+run {time.perf_counter() - t:.1f}s ok=True")
-    except Exception as e:
-        hb(f"fast path failed ({type(e).__name__}: {str(e)[:120]}); retrying with pallas disabled")
-        limb.set_pallas(False)
-        kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
-        t = time.perf_counter()
-        ok = kernel(wp, wm, ws)
-        ok.block_until_ready()
-        assert bool(ok.all()), "warmup verification failed (fallback)"
-        hb(f"fallback warmup compile+run {time.perf_counter() - t:.1f}s ok=True")
+    def run_verify(args, label: str):
+        """Run the kernel; on the FIRST failure disable the Pallas fast
+        path and retry once on the pure-XLA engine (a second failure is
+        final — there is nothing left to fall back to)."""
+        try:
+            t = time.perf_counter()
+            ok = state["kernel"](*args)
+            ok.block_until_ready()
+            hb(f"{label} compile+run {time.perf_counter() - t:.1f}s")
+        except Exception as e:
+            if state["fallback"]:
+                raise
+            hb(f"{label} failed ({type(e).__name__}: {str(e)[:120]}); retrying without pallas")
+            limb.set_pallas(False)
+            state["fallback"] = True
+            state["kernel"] = jax.jit(
+                lambda p, m, s: DP.batched_verify(ctx, p, m, s)
+            )
+            t = time.perf_counter()
+            ok = state["kernel"](*args)
+            ok.block_until_ready()
+            hb(f"{label} fallback compile+run {time.perf_counter() - t:.1f}s")
+        assert bool(ok.all()), f"{label} verification failed"
+        return ok
 
+    # tiny warmup shape first: proves the pipeline + persists its kernel
+    run_verify(pack(WARMUP_BATCH), f"warmup batch={WARMUP_BATCH}")
     pk, msg, sig = pack(BATCH)
-    try:
-        t = time.perf_counter()
-        ok = kernel(pk, msg, sig)
-        ok.block_until_ready()
-        hb(f"main batch={BATCH} compile+run {time.perf_counter() - t:.1f}s")
-        assert bool(ok.all()), "bench workload failed verification"
-    except Exception as e:
-        # shape-dependent failure at the big batch (fast path or the
-        # platform's compiler): disable pallas and retry once
-        hb(f"main batch failed ({type(e).__name__}: {str(e)[:120]}); retry without pallas")
-        limb.set_pallas(False)
-        kernel = jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s))
-        t = time.perf_counter()
-        ok = kernel(pk, msg, sig)
-        ok.block_until_ready()
-        hb(f"fallback main batch compile+run {time.perf_counter() - t:.1f}s")
-        assert bool(ok.all()), "bench workload failed verification (fallback)"
+    run_verify((pk, msg, sig), f"main batch={BATCH}")
+    kernel = state["kernel"]
 
     times = []
     for i in range(ITERS):

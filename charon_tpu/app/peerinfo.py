@@ -12,6 +12,9 @@ import asyncio
 import time
 from dataclasses import dataclass, field
 
+from charon_tpu.app import log
+from charon_tpu.app import version as version_mod
+
 PROTOCOL = "peerinfo/1.0.0"
 
 
@@ -21,6 +24,7 @@ class PeerInfo:
     start_time: float
     clock_offset: float = 0.0  # peer_time - our_time at receipt
     last_seen: float = 0.0
+    compatible: bool = True  # version window check (ref: app/version)
 
 
 class PeerInfoService:
@@ -32,15 +36,35 @@ class PeerInfoService:
         self._task: asyncio.Task | None = None
         node.register_handler(PROTOCOL, self._handle)
 
+    def _record(self, idx: int, msg, now: float) -> None:
+        peer_version = msg.get("version", "?")
+        compatible = version_mod.check_compatible(peer_version)
+        prev = self.peers.get(idx)
+        if not compatible and (prev is None or prev.compatible):
+            # surface the mismatch once per transition
+            # (ref: version.Supported gating in peerinfo)
+            log.warn(
+                "peer runs an unsupported version",
+                topic="peerinfo",
+                peer=idx,
+                peer_version=peer_version,
+                ours=self.version,
+            )
+        self.peers[idx] = PeerInfo(
+            version=peer_version,
+            start_time=msg.get("start_time", 0.0),
+            clock_offset=msg.get("now", now) - now,
+            last_seen=now,
+            compatible=compatible,
+        )
+
+    def incompatible_peers(self) -> list[int]:
+        return [i for i, p in self.peers.items() if not p.compatible]
+
     async def _handle(self, from_idx: int, msg):
         now = time.time()
         if msg is not None:
-            self.peers[from_idx] = PeerInfo(
-                version=msg.get("version", "?"),
-                start_time=msg.get("start_time", 0.0),
-                clock_offset=msg.get("now", now) - now,
-                last_seen=now,
-            )
+            self._record(from_idx, msg, now)
         return {
             "version": self.version,
             "start_time": self.start_time,
@@ -60,13 +84,7 @@ class PeerInfoService:
                     },
                     await_response=True,
                 )
-                now = time.time()
-                self.peers[idx] = PeerInfo(
-                    version=resp.get("version", "?"),
-                    start_time=resp.get("start_time", 0.0),
-                    clock_offset=resp.get("now", now) - now,
-                    last_seen=now,
-                )
+                self._record(idx, resp, time.time())
             except Exception:
                 pass
 

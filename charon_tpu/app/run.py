@@ -96,6 +96,19 @@ async def build_node(config: Config) -> Node:
         from charon_tpu.tbls.tpu_impl import TPUImpl
 
         tbls.set_implementation(TPUImpl())
+    else:
+        # host path: prefer the native C++ backend — pure-Python pairing
+        # (~0.3 s/verify) stalls the event loop for whole slots
+        try:
+            from charon_tpu.tbls.native_impl import NativeImpl
+
+            tbls.set_implementation(NativeImpl())
+        except Exception as e:
+            log.warn(
+                "native tbls backend unavailable; pure-python crypto",
+                topic="app",
+                err=str(e),
+            )
 
     # -- key material -----------------------------------------------------
     share_secrets = keystore.load_keys(data_dir / "validator_keys")
@@ -164,6 +177,18 @@ async def build_node(config: Config) -> Node:
         qbft_net = TcpQbftNet(p2p_node)
         parsig_transport = TcpParSigTransport(p2p_node)
         life.register_stop(Order.P2P, "p2p", p2p_node.stop)
+
+        # peer metadata + version-compat monitoring (ref: app/app.go:299)
+        from charon_tpu.app import version as version_mod
+        from charon_tpu.app.peerinfo import PeerInfoService
+
+        peerinfo = PeerInfoService(p2p_node, version_mod.VERSION)
+        peerinfo.start()
+
+        async def stop_peerinfo():
+            peerinfo.stop()
+
+        life.register_stop(Order.P2P, "peerinfo", stop_peerinfo)
     else:
         # single-node / in-memory configurations (tests wire their own)
         from charon_tpu.core.consensus_qbft import MemMsgNet

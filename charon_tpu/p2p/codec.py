@@ -60,6 +60,23 @@ def _from_jsonable(v: Any) -> Any:
             cls = _REGISTRY.get(v["__t"])
             if cls is None:
                 raise ValueError(f"unknown wire type {v['__t']}")
+            # protonil-equivalent guard (ref: app/protonil): REQUIRED
+            # fields (those without declared defaults) must be present on
+            # the wire — a peer cannot smuggle zero values by omission.
+            # Fields with defaults are explicit opt-ins to omissibility,
+            # which is what lets a newer minor add fields without
+            # breaking the cross-minor window app/version promises.
+            missing = [
+                f.name
+                for f in dataclasses.fields(cls)
+                if f.name not in v
+                and f.default is dataclasses.MISSING
+                and f.default_factory is dataclasses.MISSING
+            ]
+            if missing:
+                raise ValueError(
+                    f"wire message {v['__t']} missing fields {missing}"
+                )
             kwargs = {
                 f.name: _from_jsonable(v[f.name])
                 for f in dataclasses.fields(cls)

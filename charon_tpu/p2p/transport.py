@@ -27,7 +27,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
-from charon_tpu.app import k1util
+from charon_tpu.app import k1util, log
 from charon_tpu.p2p import codec
 
 MAX_FRAME = 128 * 1024 * 1024  # ref: p2p/sender.go:26
@@ -343,20 +343,39 @@ class P2PNode:
         try:
             while True:
                 frame = await _read_sframe(conn)
-                env = json.loads(frame)
-                if env["k"] == "rsp":
-                    fut = self._pending.pop(env["id"], None)
-                    if fut is not None and not fut.done():
-                        fut.set_result(codec._from_jsonable(env["d"]))
+                # Per-frame fault isolation: a malformed payload or a
+                # handler bug drops THAT frame, not the authenticated
+                # connection carrying live consensus traffic (frame
+                # integrity itself is the MAC's job in _read_sframe).
+                try:
+                    env = json.loads(frame)
+                    if env["k"] == "rsp":
+                        fut = self._pending.pop(env["id"], None)
+                        if fut is not None and not fut.done():
+                            fut.set_result(codec._from_jsonable(env["d"]))
+                        continue
+                    handler = self._handlers.get(env["p"])
+                    if handler is None:
+                        continue
+                    msg = (
+                        codec._from_jsonable(env["d"])
+                        if env["d"] is not None
+                        else None
+                    )
+                    # Source = the connection's authenticated peer index;
+                    # a sender-claimed envelope field would allow
+                    # impersonation (ADVICE round 1).
+                    resp = await handler(conn.peer_idx, msg)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    log.warn(
+                        "dropping bad frame",
+                        topic="p2p",
+                        peer=conn.peer_idx,
+                        err=f"{type(e).__name__}: {e}",
+                    )
                     continue
-                handler = self._handlers.get(env["p"])
-                if handler is None:
-                    continue
-                msg = codec._from_jsonable(env["d"]) if env["d"] is not None else None
-                # Source = the connection's authenticated peer index; a
-                # sender-claimed envelope field would allow impersonation
-                # (ADVICE round 1).
-                resp = await handler(conn.peer_idx, msg)
                 if resp is not None:
                     out = {
                         "p": env["p"],
