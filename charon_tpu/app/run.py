@@ -55,6 +55,7 @@ class Config:
     monitoring_port: int = 0
     peer_addrs: list[tuple[str, int]] = field(default_factory=list)
     beacon_nodes: list = field(default_factory=list)  # client objects
+    beacon_urls: list[str] = field(default_factory=list)  # HTTP endpoints
     simnet: bool = False
     simnet_vmock: bool = True  # in-process VC in simnet (ref: app/vmock.go)
     slot_duration: float = 12.0
@@ -134,6 +135,39 @@ async def build_node(config: Config) -> Node:
     # -- beacon client ----------------------------------------------------
     import time as _time
 
+    http_clients = []
+    if config.beacon_urls and not config.beacon_nodes:
+        from charon_tpu.app.eth2http import Eth2HttpClient
+
+        http_clients = [Eth2HttpClient(url) for url in config.beacon_urls]
+        config.beacon_nodes = list(http_clients)
+        # derive chain timing from the node itself unless configured
+        # (ref: app/app.go:754 uses Spec()/genesis from the BN)
+        for client in http_clients:
+            try:
+                if config.genesis_time is None:
+                    genesis = await client.genesis()
+                    config.genesis_time = float(genesis["genesis_time"])
+                spec = await client.spec()
+                config.slot_duration = float(
+                    spec.get("SECONDS_PER_SLOT", config.slot_duration)
+                )
+                config.slots_per_epoch = int(
+                    spec.get("SLOTS_PER_EPOCH", config.slots_per_epoch)
+                )
+                break
+            except Exception as e:
+                log.warn(
+                    "failed to fetch chain spec from beacon node",
+                    topic="app",
+                    url=client.base_url,
+                    err=str(e),
+                )
+        if config.genesis_time is None:
+            raise RuntimeError(
+                "could not determine genesis time from any beacon node; "
+                "pass --genesis-time"
+            )
     if config.simnet or not config.beacon_nodes:
         from charon_tpu.testutil.beaconmock import BeaconMock
 
@@ -159,6 +193,13 @@ async def build_node(config: Config) -> Node:
         peer=f"node{config.node_index}",
     )
     life = LifecycleManager()
+    if http_clients:
+
+        async def close_clients():
+            for client in http_clients:
+                await client.close()
+
+        life.register_stop(Order.P2P, "beacon-http", close_clients)
 
     # -- p2p --------------------------------------------------------------
     p2p_node = None

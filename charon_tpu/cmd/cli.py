@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated host:port per operator (index order)",
     )
     runp.add_argument("--no-tpu", action="store_true", help="use the pure-python tbls backend")
+    runp.add_argument(
+        "--beacon-urls",
+        default=_env_default("beacon-urls", ""),
+        help="comma-separated beacon-node HTTP endpoints (failover order)",
+    )
 
     create = sub.add_parser(
         "create-cluster",
@@ -259,6 +264,9 @@ def cmd_run(args) -> int:
         p2p_port=args.p2p_port,
         peer_addrs=peer_addrs,
         simnet=args.simnet,
+        beacon_urls=[
+            u.strip() for u in args.beacon_urls.split(",") if u.strip()
+        ],
         slot_duration=args.slot_duration,
         slots_per_epoch=args.slots_per_epoch,
         genesis_time=args.genesis_time,

@@ -111,8 +111,15 @@ class Scheduler:
             t.cancel()
 
     async def run(self) -> None:
-        """Tick slots until stopped (ref: scheduler.go:97 Run)."""
-        await self.beacon.await_synced()
+        """Tick slots until stopped (ref: scheduler.go:97 Run). Waits for
+        beacon sync first, retrying single-shot probes (ref:
+        scheduler.go:678 waitBeaconSync)."""
+        while not self._stop.is_set():
+            try:
+                await self.beacon.await_synced()
+                break
+            except Exception:
+                await asyncio.sleep(5)
         while not self._stop.is_set():
             now = self._now()
             slot_no = self.clock.slot_at(now)

@@ -1,0 +1,176 @@
+"""Eth2HttpClient vs a beacon REST mock (the production upstream path,
+ref: app/eth2wrap NewMultiHTTP + go-eth2-client role).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from charon_tpu.app.eth2http import Eth2HttpClient, _bits, _bits_hex
+from charon_tpu.app.eth2wrap import MultiClient
+from charon_tpu.core.eth2data import (
+    Attestation,
+    AttestationData,
+    Checkpoint,
+)
+
+
+class BeaconRestMock:
+    """Subset of the beacon REST API the client speaks."""
+
+    def __init__(self) -> None:
+        self.attestations: list = []
+        self.syncing_responses = [False]
+
+    async def start(self) -> int:
+        app = web.Application()
+        app.router.add_get("/eth/v1/node/syncing", self._syncing)
+        app.router.add_post(
+            "/eth/v1/validator/duties/attester/{epoch}", self._att_duties
+        )
+        app.router.add_get(
+            "/eth/v1/validator/attestation_data", self._att_data
+        )
+        app.router.add_post(
+            "/eth/v1/beacon/pool/attestations", self._pool_att
+        )
+        app.router.add_get(
+            "/eth/v1/beacon/blocks/{slot}/attestations", self._block_atts
+        )
+        app.router.add_get(
+            "/eth/v1/beacon/blocks/{slot}/root", self._block_root
+        )
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        return site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        await self._runner.cleanup()
+
+    async def _syncing(self, request):
+        return web.json_response(
+            {"data": {"is_syncing": self.syncing_responses.pop(0)
+                      if len(self.syncing_responses) > 1
+                      else self.syncing_responses[0]}}
+        )
+
+    async def _att_duties(self, request):
+        indices = await request.json()
+        return web.json_response(
+            {
+                "data": [
+                    {
+                        "slot": "7",
+                        "validator_index": idx,
+                        "committee_index": "2",
+                        "committee_length": "128",
+                        "committees_at_slot": "4",
+                        "validator_committee_index": "5",
+                    }
+                    for idx in indices
+                ]
+            }
+        )
+
+    async def _att_data(self, request):
+        slot = request.query["slot"]
+        return web.json_response(
+            {
+                "data": {
+                    "slot": slot,
+                    "index": request.query["committee_index"],
+                    "beacon_block_root": "0x" + "0a" * 32,
+                    "source": {"epoch": "0", "root": "0x" + "0b" * 32},
+                    "target": {"epoch": "1", "root": "0x" + "0c" * 32},
+                }
+            }
+        )
+
+    async def _pool_att(self, request):
+        self.attestations.extend(await request.json())
+        return web.json_response({})
+
+    async def _block_atts(self, request):
+        if request.match_info["slot"] == "404":
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"data": self.attestations})
+
+    async def _block_root(self, request):
+        return web.json_response({"data": {"root": "0x" + "0d" * 32}})
+
+
+def test_http_client_roundtrip():
+    async def run():
+        mock = BeaconRestMock()
+        port = await mock.start()
+        client = Eth2HttpClient(f"http://127.0.0.1:{port}")
+        try:
+            await client.await_synced()
+
+            # single-shot probe semantics: syncing -> NotSyncedError
+            from charon_tpu.app.eth2http import NotSyncedError
+
+            mock.syncing_responses.insert(0, True)
+            with pytest.raises(NotSyncedError):
+                await client.await_synced()
+            await client.await_synced()  # back to synced
+
+            duties = await client.attester_duties(0, {b"\xaa" * 48: 3})
+            assert duties[0]["pubkey"] == b"\xaa" * 48
+            assert duties[0]["committee_length"] == 128
+
+            data = await client.attestation_data(7, 2)
+            assert data.slot == 7 and data.index == 2
+            assert data.target == Checkpoint(1, b"\x0c" * 32)
+
+            att = Attestation(
+                aggregation_bits=(False, True, False),
+                data=data,
+                signature=b"\x0e" * 96,
+            )
+            await client.submit_attestation(att)
+            assert len(mock.attestations) == 1
+
+            # inclusion surface round-trips the submitted attestation
+            atts = await client.block_attestations(8)
+            assert atts[0].data.slot == 7
+            assert atts[0].aggregation_bits == (False, True, False)
+            root = await client.block_root(8)
+            assert root == b"\x0d" * 32
+        finally:
+            await client.close()
+            await mock.stop()
+
+    asyncio.run(run())
+
+
+def test_bits_roundtrip():
+    for bits in [(), (True,), (False, True, True), tuple([True] * 9)]:
+        assert _bits(_bits_hex(bits)) == bits
+
+
+def test_multiclient_failover_to_http():
+    """A dead endpoint fails over to the live one (ref: multi.go)."""
+
+    async def run():
+        mock = BeaconRestMock()
+        port = await mock.start()
+        dead = Eth2HttpClient("http://127.0.0.1:1", timeout=0.5)
+        live = Eth2HttpClient(f"http://127.0.0.1:{port}")
+        multi = MultiClient([dead, live], timeout=2.0)
+        try:
+            data = await multi.attestation_data(7, 2)
+            assert data.slot == 7
+            # the dead client accumulated an error; live is promoted
+            assert multi.errors[0] > 0
+        finally:
+            await dead.close()
+            await live.close()
+            await mock.stop()
+
+    asyncio.run(run())
