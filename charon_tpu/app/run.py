@@ -357,6 +357,10 @@ async def build_node(config: Config) -> Node:
         slot_duration=config.slot_duration,
         clock=clock,
     )
+    if config.beacon_urls:
+        # unmatched VC requests forward to the first beacon endpoint
+        # (ref: router.go proxyHandler)
+        vapi_router.proxy_url = config.beacon_urls[0]
 
     # -- lifecycle hooks --------------------------------------------------
     async def start_vapi():

@@ -313,7 +313,12 @@ class VapiRouter:
                 web.get("/eth/v1/beacon/states/{state_id}/fork", self._state_fork),
             ]
         )
+        # everything else is proxied verbatim to the upstream beacon node
+        # when one is configured (ref: router.go proxyHandler — the
+        # reference forwards unmatched beacon-API traffic to the BN)
+        self.app.router.add_route("*", "/{tail:.*}", self._proxy)
         self._runner: web.AppRunner | None = None
+        self.proxy_url: str | None = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._runner = web.AppRunner(self.app)
@@ -325,6 +330,36 @@ class VapiRouter:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+
+    async def _proxy(self, request: web.Request) -> web.Response:
+        if not self.proxy_url:
+            return _err(404, f"unknown endpoint {request.path}")
+        import aiohttp
+
+        url = self.proxy_url.rstrip("/") + request.path_qs
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10)
+            ) as session:
+                async with session.request(
+                    request.method,
+                    url,
+                    data=await request.read(),
+                    headers={
+                        k: v
+                        for k, v in request.headers.items()
+                        if k.lower()
+                        not in ("host", "connection", "content-length")
+                    },
+                ) as resp:
+                    body = await resp.read()
+                    return web.Response(
+                        status=resp.status,
+                        body=body,
+                        content_type=resp.content_type,
+                    )
+        except Exception as e:
+            return _err(502, f"beacon proxy failed: {e}")
 
     # -- pubkey resolution -------------------------------------------------
 

@@ -174,3 +174,45 @@ def test_multiclient_failover_to_http():
             await mock.stop()
 
     asyncio.run(run())
+
+
+def test_vapi_router_proxies_unmatched_to_beacon():
+    """Unmatched VC endpoints forward to the upstream BN when configured
+    (ref: core/validatorapi/router.go proxyHandler)."""
+    import aiohttp
+
+    from charon_tpu.core.validatorapi import ValidatorAPI
+    from charon_tpu.core.vapi_http import VapiRouter
+    from charon_tpu.eth2util.signing import ForkInfo
+
+    async def run():
+        mock = BeaconRestMock()
+        beacon_port = await mock.start()
+
+        fork = ForkInfo(b"\x42" * 32, b"\x00" * 4, b"\x00" * 4)
+        vapi = ValidatorAPI(share_idx=1, pubshares={}, fork=fork)
+        router = VapiRouter(vapi)
+        port = await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                # no proxy configured: 404
+                async with s.get(
+                    f"http://127.0.0.1:{port}/eth/v1/node/syncing_custom"
+                ) as resp:
+                    assert resp.status == 404
+
+                router.proxy_url = f"http://127.0.0.1:{beacon_port}"
+                # /eth/v1/node/syncing is served natively; an endpoint the
+                # router doesn't know is proxied through
+                async with s.get(
+                    f"http://127.0.0.1:{port}"
+                    "/eth/v1/beacon/blocks/8/root"
+                ) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+                    assert data["data"]["root"] == "0x" + "0d" * 32
+        finally:
+            await router.stop()
+            await mock.stop()
+
+    asyncio.run(run())
