@@ -82,6 +82,213 @@ class MultiClient:
         return call
 
 
+class InstrumentedClient:
+    """Per-endpoint latency/error instrumentation around a beacon client
+    (ref: app/eth2wrap/eth2wrap_gen.go wraps every generated method with
+    latency() + incError(); metrics app_eth2_latency_seconds /
+    app_eth2_errors_total in docs/metrics.md).
+
+    `metrics` is a ClusterMetrics (app/metrics.py); falls back to local
+    in-memory tallies when None so tests can instrument without a
+    registry."""
+
+    def __init__(self, client: Any, metrics=None, name: str = "beacon") -> None:
+        self._client = client
+        self._metrics = metrics
+        self._name = name
+        self.latency: dict[str, list[float]] = defaultdict(list)
+        self.error_count: dict[str, int] = defaultdict(int)
+
+    def __getattr__(self, name: str):
+        inner = getattr(self._client, name)
+        if not callable(inner) or name.startswith("_"):
+            return inner
+
+        async def call(*args, **kwargs):
+            t0 = time.monotonic()
+            try:
+                result = await inner(*args, **kwargs)
+            except BaseException:
+                # BaseException: asyncio.CancelledError (e.g. the enclosing
+                # MultiClient's wait_for timing this BN out) must count as
+                # an error too, or a hung BN shows perfectly healthy metrics
+                self.error_count[name] += 1
+                if self._metrics is not None:
+                    self._metrics.labels(
+                        self._metrics.eth2_errors, self._name, name
+                    ).inc()
+                raise
+            elapsed = time.monotonic() - t0
+            self.latency[name].append(elapsed)
+            if self._metrics is not None:
+                self._metrics.labels(
+                    self._metrics.eth2_latency, self._name, name
+                ).observe(elapsed)
+            return result
+
+        return call
+
+
+class LazyClient:
+    """Connect-on-first-use beacon client with reconnect-on-failure
+    (ref: app/eth2wrap/lazy.go:28 — the lazy client defers dialing the BN
+    until the first call and rebuilds the underlying client when a call
+    fails, so charon starts cleanly while its BN is still syncing/down).
+
+    `factory` is an async callable returning a connected client. After a
+    call fails the cached client is dropped; the next call redials with
+    exponential backoff bounded by `max_backoff`."""
+
+    def __init__(self, factory, max_backoff: float = 30.0) -> None:
+        self._factory = factory
+        self._client: Any = None
+        self._lock = asyncio.Lock()
+        self._backoff = ExpBackoff(max_delay=max_backoff)
+
+    async def _get(self):
+        async with self._lock:
+            if self._client is None:
+                await self._backoff.wait()
+                self._client = await self._factory()
+                self._backoff.reset()
+            return self._client
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def call(*args, **kwargs):
+            client = await self._get()
+            try:
+                return await getattr(client, name)(*args, **kwargs)
+            except Exception:
+                async with self._lock:
+                    if self._client is client:  # drop the broken client
+                        self._client = None
+                raise
+
+        return call
+
+
+class ExpBackoff:
+    """Exponential backoff with full jitter and reset
+    (ref: app/expbackoff/expbackoff.go — used by the relay reserver and
+    DKG sync clients)."""
+
+    def __init__(
+        self,
+        base: float = 0.25,
+        factor: float = 2.0,
+        max_delay: float = 30.0,
+        jitter: bool = True,
+    ) -> None:
+        self.base = base
+        self.factor = factor
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self._attempt = 0
+
+    def next_delay(self) -> float:
+        import random
+
+        delay = min(self.max_delay, self.base * self.factor**self._attempt)
+        self._attempt += 1
+        return random.uniform(0, delay) if self.jitter else delay
+
+    async def wait(self) -> None:
+        if self._attempt:
+            await asyncio.sleep(self.next_delay())
+        else:
+            self._attempt = 1
+
+    def reset(self) -> None:
+        self._attempt = 0
+
+
+SYNTH_GRAFFITI = b"charon-tpu-synthetic"
+
+
+class SyntheticProposerClient:
+    """Synthetic block-proposal duties for idle validators
+    (ref: app/eth2wrap/synthproposer.go — WithSyntheticDuties fabricates
+    deterministic proposer duties for validators that have none in an
+    epoch, serves marker-graffiti blocks for them, and swallows their
+    submission so the whole proposer pipeline is exercised in testing
+    without hitting the chain).
+
+    Deterministic assignment: validator v proposes at slot
+    epoch_start + (stable_hash(pubkey, epoch) % SLOTS_PER_EPOCH)."""
+
+    def __init__(self, client: Any, slots_per_epoch: int = 32) -> None:
+        self._client = client
+        self.slots_per_epoch = slots_per_epoch
+        self.synthetic_submitted = 0
+        self._synth_slots: set[int] = set()  # slots WE fabricated duties for
+
+    def _synth_slot(self, epoch: int, pubkey: bytes) -> int:
+        import hashlib
+
+        h = hashlib.sha256(b"synth-proposer" + pubkey + epoch.to_bytes(8, "big"))
+        return epoch * self.slots_per_epoch + (
+            int.from_bytes(h.digest()[:4], "big") % self.slots_per_epoch
+        )
+
+    async def proposer_duties(self, epoch: int, validators):
+        real = list(await self._client.proposer_duties(epoch, validators))
+        have = {d.get("pubkey") if isinstance(d, dict) else d[0] for d in real}
+        # validators: mapping pubkey -> validator index (the shape the
+        # scheduler passes), or a plain pubkey sequence in tests
+        items = (
+            validators.items()
+            if isinstance(validators, dict)
+            else [(v, i) for i, v in enumerate(validators)]
+        )
+        for pk, vidx in items:
+            if pk in have:
+                continue
+            raw = pk if isinstance(pk, bytes) else str(pk).encode()
+            slot = self._synth_slot(epoch, raw)
+            self._synth_slots.add(slot)
+            real.append(
+                {
+                    "pubkey": pk,
+                    "slot": slot,
+                    "validator_index": vidx,
+                    "synthetic": True,
+                }
+            )
+        return real
+
+    async def block_proposal(self, slot: int, *args, randao_reveal=None, graffiti=None, **kw):
+        if slot in self._synth_slots:
+            # ONLY slots we fabricated duties for get synthetic blocks; a
+            # transient BN failure on a real duty must propagate so the
+            # retryer can re-fetch it (ref: synthproposer.go consults its
+            # own duty cache before synthesizing)
+            return {
+                "slot": slot,
+                "graffiti": SYNTH_GRAFFITI.hex(),
+                "synthetic": True,
+                "body": {"randao_reveal": randao_reveal},
+            }
+        return await self._client.block_proposal(
+            slot, *args, randao_reveal=randao_reveal, graffiti=graffiti, **kw
+        )
+
+    async def submit_proposal(self, signed_block, *a, **kw):
+        block = getattr(signed_block, "message", signed_block)
+        if isinstance(block, dict) and (
+            block.get("synthetic")
+            or block.get("graffiti") == SYNTH_GRAFFITI.hex()
+        ):
+            self.synthetic_submitted += 1  # swallowed, never broadcast
+            return None
+        return await self._client.submit_proposal(signed_block, *a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+
 class ValidatorCache:
     """Per-epoch cache of duty queries (ref: eth2wrap/valcache.go)."""
 

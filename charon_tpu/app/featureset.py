@@ -23,6 +23,7 @@ class Feature(str, enum.Enum):
     QBFT_CONSENSUS = "qbft_consensus"
     TPU_BATCH_VERIFY = "tpu_batch_verify"
     JSON_REQUESTS = "json_requests"
+    SYNTHETIC_DUTIES = "synthetic_duties"
 
 
 _STATUSES: dict[Feature, Status] = {
@@ -30,6 +31,8 @@ _STATUSES: dict[Feature, Status] = {
     Feature.QBFT_CONSENSUS: Status.STABLE,
     Feature.TPU_BATCH_VERIFY: Status.STABLE,
     Feature.JSON_REQUESTS: Status.BETA,
+    # ref: app/eth2wrap/synthproposer.go is test-path-only; alpha here
+    Feature.SYNTHETIC_DUTIES: Status.ALPHA,
 }
 
 _min_status = Status.STABLE

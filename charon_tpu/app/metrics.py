@@ -67,6 +67,18 @@ class ClusterMetrics:
             labels,
             registry=self.registry,
         )
+        self.eth2_latency = Histogram(
+            "app_eth2_latency_seconds",
+            "Beacon-node request latency per endpoint",
+            labels + ["client", "endpoint"],
+            registry=self.registry,
+        )
+        self.eth2_errors = Counter(
+            "app_eth2_errors_total",
+            "Beacon-node request errors per endpoint",
+            labels + ["client", "endpoint"],
+            registry=self.registry,
+        )
         self.batch_size = Histogram(
             "tpu_batch_size",
             "Device batch sizes for crypto kernels",

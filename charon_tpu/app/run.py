@@ -15,8 +15,13 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from charon_tpu import tbls
-from charon_tpu.app import k1util, log, tracer
-from charon_tpu.app.eth2wrap import MultiClient, ValidatorCache
+from charon_tpu.app import featureset, k1util, log, tracer
+from charon_tpu.app.eth2wrap import (
+    InstrumentedClient,
+    MultiClient,
+    SyntheticProposerClient,
+    ValidatorCache,
+)
 from charon_tpu.app.lifecycle import LifecycleManager, Order
 from charon_tpu.app.metrics import ClusterMetrics, instrument, serve_monitoring
 from charon_tpu.cluster.lock import ClusterLock
@@ -132,6 +137,13 @@ async def build_node(config: Config) -> Node:
 
     fork = lock.fork_info()
 
+    # -- metrics ----------------------------------------------------------
+    metrics = ClusterMetrics(
+        cluster_hash="0x" + lock.lock_hash().hex()[:16],
+        cluster_name=lock.definition.name,
+        peer=f"node{config.node_index}",
+    )
+
     # -- beacon client ----------------------------------------------------
     import time as _time
 
@@ -183,15 +195,22 @@ async def build_node(config: Config) -> Node:
         )
         clock = beacon.clock()
     else:
-        beacon = ValidatorCache(MultiClient(config.beacon_nodes))
+        # each BN gets latency/error instrumentation before the failover
+        # multi-client (ref: app/eth2wrap Instrument + NewMultiHTTP)
+        instrumented = [
+            InstrumentedClient(c, metrics, name=f"bn{i}")
+            for i, c in enumerate(config.beacon_nodes)
+        ]
+        beacon = ValidatorCache(MultiClient(instrumented))
         clock = SlotClock(config.genesis_time or 0.0, config.slot_duration)
+    if featureset.enabled(featureset.Feature.SYNTHETIC_DUTIES):
+        # fabricate proposer duties for idle validators so the proposal
+        # pipeline is exercised (ref: eth2wrap.WithSyntheticDuties)
+        beacon = SyntheticProposerClient(
+            beacon, slots_per_epoch=config.slots_per_epoch
+        )
 
-    # -- metrics / lifecycle ----------------------------------------------
-    metrics = ClusterMetrics(
-        cluster_hash="0x" + lock.lock_hash().hex()[:16],
-        cluster_name=lock.definition.name,
-        peer=f"node{config.node_index}",
-    )
+    # -- lifecycle ---------------------------------------------------------
     life = LifecycleManager()
     if http_clients:
 

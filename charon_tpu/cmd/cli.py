@@ -184,6 +184,22 @@ def build_parser() -> argparse.ArgumentParser:
     tbeacon = testsub.add_parser("beacon", help="measure beacon-node latency")
     tbeacon.add_argument("--beacon-url", required=True)
     tbeacon.add_argument("--count", type=int, default=5)
+    tvc = testsub.add_parser(
+        "validator", help="measure validator-API latency (ref: cmd/testvalidator.go)"
+    )
+    tvc.add_argument("--validator-api-url", required=True)
+    tvc.add_argument("--count", type=int, default=5)
+    tmev = testsub.add_parser(
+        "mev", help="measure MEV-boost relay latency (ref: cmd/testmev.go)"
+    )
+    tmev.add_argument("--mev-url", required=True)
+    tmev.add_argument("--count", type=int, default=5)
+    tperf = testsub.add_parser(
+        "performance",
+        help="local disk/hash/BLS throughput diagnostics "
+        "(ref: cmd/testperformance.go)",
+    )
+    tperf.add_argument("--duration", type=float, default=1.0)
 
     sub.add_parser("version", help="print version")
     return p
@@ -787,12 +803,63 @@ def cmd_test(args) -> int:
 
         return asyncio.run(run_all())
 
-    # test beacon
+    if args.test_command == "performance":
+        # local machine diagnostics (ref: cmd/testperformance.go measures
+        # disk and networking envelopes): sequential disk write MB/s,
+        # SHA-256 MB/s, and host-backend BLS verify sigs/sec — the three
+        # resources a charon-tpu node leans on.
+        import hashlib
+        import os
+        import tempfile
+
+        chunk = os.urandom(4 << 20)
+        t0, written = time.perf_counter(), 0
+        with tempfile.NamedTemporaryFile(dir=".") as f:
+            while time.perf_counter() - t0 < args.duration:
+                f.write(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+                written += len(chunk)
+        disk = written / (time.perf_counter() - t0) / 1e6
+        print(f"disk_write: {disk:.0f} MB/s")
+
+        t0, hashed = time.perf_counter(), 0
+        while time.perf_counter() - t0 < args.duration:
+            hashlib.sha256(chunk).digest()
+            hashed += len(chunk)
+        print(f"sha256: {hashed / (time.perf_counter() - t0) / 1e6:.0f} MB/s")
+
+        try:
+            from charon_tpu.tbls.native_impl import NativeImpl
+
+            impl = NativeImpl()
+            sk = (123).to_bytes(32, "big")
+            pk = impl.secret_to_public_key(sk)
+            sig = impl.sign(sk, b"perf-probe")
+            t0, n = time.perf_counter(), 0
+            while time.perf_counter() - t0 < args.duration:
+                impl.verify(pk, b"perf-probe", sig)
+                n += 1
+            print(f"bls_verify_host: {n / (time.perf_counter() - t0):.0f} sigs/s")
+        except Exception as e:  # native backend optional on exotic hosts
+            print(f"bls_verify_host: unavailable ({e})")
+        return 0
+
+    # test beacon / validator / mev: HTTP latency probes against the
+    # service's cheap status endpoint
     import aiohttp
 
-    async def probe_beacon():
+    probes = {
+        "beacon": ("beacon_url", "/eth/v1/node/version"),
+        "validator": ("validator_api_url", "/eth/v1/node/version"),
+        "mev": ("mev_url", "/eth/v1/builder/status"),
+    }
+    attr, path = probes[args.test_command]
+    base = getattr(args, attr)
+
+    async def probe_http():
         samples, errs = [], 0
-        url = args.beacon_url.rstrip("/") + "/eth/v1/node/version"
+        url = base.rstrip("/") + path
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=3)
         ) as s:
@@ -809,9 +876,13 @@ def cmd_test(args) -> int:
                             errs += 1
                 except Exception:
                     errs += 1
-        return 0 if stats_line(f"beacon {args.beacon_url}", samples, errs) else 1
+        return (
+            0
+            if stats_line(f"{args.test_command} {base}", samples, errs)
+            else 1
+        )
 
-    return asyncio.run(probe_beacon())
+    return asyncio.run(probe_http())
 
 
 def main(argv=None) -> int:

@@ -319,6 +319,7 @@ class VapiRouter:
         self.app.router.add_route("*", "/{tail:.*}", self._proxy)
         self._runner: web.AppRunner | None = None
         self.proxy_url: str | None = None
+        self._proxy_session = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._runner = web.AppRunner(self.app)
@@ -328,36 +329,66 @@ class VapiRouter:
         return site._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
+        if self._proxy_session is not None:
+            await self._proxy_session.close()
+            self._proxy_session = None
         if self._runner:
             await self._runner.cleanup()
+
+    # hop-by-hop headers never forwarded in either direction (RFC 9110 §7.6)
+    _HOP_HEADERS = frozenset(
+        (
+            "host",
+            "connection",
+            "content-length",
+            "transfer-encoding",
+            "keep-alive",
+            "upgrade",
+            "proxy-authenticate",
+            "proxy-authorization",
+            "te",
+            "trailer",
+        )
+    )
 
     async def _proxy(self, request: web.Request) -> web.Response:
         if not self.proxy_url:
             return _err(404, f"unknown endpoint {request.path}")
         import aiohttp
 
+        if self._proxy_session is None or self._proxy_session.closed:
+            # one pooled session for the VC hot path — per-request
+            # sessions would pay TCP/TLS setup on every proxied call
+            self._proxy_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10)
+            )
         url = self.proxy_url.rstrip("/") + request.path_qs
         try:
-            async with aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=10)
-            ) as session:
-                async with session.request(
-                    request.method,
-                    url,
-                    data=await request.read(),
-                    headers={
-                        k: v
-                        for k, v in request.headers.items()
-                        if k.lower()
-                        not in ("host", "connection", "content-length")
-                    },
-                ) as resp:
-                    body = await resp.read()
-                    return web.Response(
-                        status=resp.status,
-                        body=body,
-                        content_type=resp.content_type,
-                    )
+            async with self._proxy_session.request(
+                request.method,
+                url,
+                data=await request.read(),
+                headers={
+                    k: v
+                    for k, v in request.headers.items()
+                    if k.lower() not in self._HOP_HEADERS
+                },
+            ) as resp:
+                body = await resp.read()
+                # forward end-to-end response headers: the VC needs e.g.
+                # Eth-Consensus-Version to decode fork-aware bodies
+                headers = {
+                    k: v
+                    for k, v in resp.headers.items()
+                    if k.lower() not in self._HOP_HEADERS
+                    and k.lower() != "content-type"
+                }
+                return web.Response(
+                    status=resp.status,
+                    body=body,
+                    content_type=resp.content_type,
+                    headers=headers,
+                )
         except Exception as e:
             return _err(502, f"beacon proxy failed: {e}")
 

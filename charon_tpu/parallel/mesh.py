@@ -65,17 +65,13 @@ class SlotCryptoPlane:
         g2f = C.g2_ops(ctx)
 
         def local_step(pubshares, msg, partials, group_pk, indices, live):
-            # [Vl, t] partial verifies: flatten share axis into the batch.
-            flat = jax.tree_util.tree_map(
-                lambda a: a.reshape(-1, *a.shape[2:]), (pubshares, partials)
-            )
-            msg_rep = jax.tree_util.tree_map(
-                lambda a: jnp.repeat(a, t, axis=0), msg
-            )
-            part_ok = DP.batched_verify(ctx, flat[0], msg_rep, flat[1])
-            part_ok = part_ok.reshape(-1, t)
-
-            # Threshold recombination [Vl].
+            # Threshold recombination first [Vl] — it has no data dependency
+            # on the verifies, and doing it first lets BOTH verify tiers run
+            # as ONE batched pairing program over Vl*(t+1) lanes (a single
+            # Miller-loop/final-exp subgraph in the compiled module instead
+            # of two, which halves the dominant XLA compile cost and keeps
+            # the device busy with one large batch instead of two smaller
+            # ones).
             coeffs = blsops.lagrange_coeffs_at_zero(fr_ctx, indices, t)
             proj = C.affine_to_point(g2f, partials)
             scaled = C.point_scalar_mul(g2f, fr_ctx, proj, coeffs)
@@ -83,10 +79,18 @@ class SlotCryptoPlane:
                 g2f, C.point_sum(g2f, scaled, axis=-1)
             )
 
-            # Group verify [Vl].
-            group_ok = DP.batched_verify(ctx, group_pk, msg, group_sig)
-
-            ok = jnp.logical_and(jnp.all(part_ok, axis=-1), group_ok)
+            # Verify lanes: [Vl, t] per-share partials ++ [Vl, 1] group sig,
+            # flattened to one [Vl*(t+1)] batch.
+            cat = lambda a, b: jnp.concatenate(
+                (a, b[:, None, ...]), axis=1
+            ).reshape(-1, *a.shape[2:])
+            pk_all = jax.tree_util.tree_map(cat, pubshares, group_pk)
+            sig_all = jax.tree_util.tree_map(cat, partials, group_sig)
+            msg_rep = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, t + 1, axis=0), msg
+            )
+            ok_all = DP.batched_verify(ctx, pk_all, msg_rep, sig_all)
+            ok = jnp.all(ok_all.reshape(-1, t + 1), axis=-1)
             # `live` masks padding lanes (V rounded up to the mesh size)
             # out of the cluster-wide count
             ok = jnp.logical_and(ok, live)

@@ -193,6 +193,63 @@ class BeaconMock:
                 return proposal.hash_tree_root()
         return self._root("block", slot)
 
+    # -- head events (ref: testutil/beaconmock/headproducer.go — the mock
+    # serves SSE head events at /eth/v1/events; here subscribers get an
+    # asyncio queue fed once per slot by run_head_producer) --------------
+
+    def subscribe_head_events(self):
+        import asyncio
+
+        queue: asyncio.Queue = asyncio.Queue()
+        if not hasattr(self, "_head_subs"):
+            self._head_subs = []
+        self._head_subs.append(queue)
+        return queue
+
+    async def run_head_producer(self, stop_event=None) -> None:
+        """Emit one head event per slot until cancelled (or stop_event
+        set). Event shape mirrors the eth2 SSE `head` topic."""
+        import asyncio
+
+        clock = self.clock()
+        while stop_event is None or not stop_event.is_set():
+            slot = clock.slot_at(time.time())
+            await asyncio.sleep(
+                max(0.0, clock.slot_start(slot + 1) - time.time())
+            )
+            event = {
+                "slot": slot + 1,
+                "block": "0x" + (await self.block_root(slot + 1)).hex(),
+                "epoch_transition": (slot + 1) % self.slots_per_epoch == 0,
+            }
+            for q in getattr(self, "_head_subs", []):
+                q.put_nowait(event)
+
+    # -- fuzzing (ref: testutil/beaconmock/beaconmock_fuzz.go, enabled by
+    # --simnet-beacon-mock-fuzz: responses become randomized but
+    # shape-valid so the workflow's robustness is chaos-tested) ----------
+
+    def enable_fuzz(self, seed: int = 0, error_rate: float = 0.1) -> None:
+        import random as _random
+
+        rng = _random.Random(seed)
+        self._fuzz_rng = rng
+        self._fuzz_error_rate = error_rate
+
+        def fuzz_attestation_data(slot: int, committee_index: int):
+            if rng.random() < error_rate:
+                raise RuntimeError("beaconmock fuzz: synthetic BN error")
+            epoch = slot // self.slots_per_epoch
+            return AttestationData(
+                slot=rng.randrange(max(1, slot * 2) + 1),
+                index=rng.randrange(64),
+                beacon_block_root=rng.randbytes(32),
+                source=Checkpoint(max(0, epoch - 1), rng.randbytes(32)),
+                target=Checkpoint(epoch, rng.randbytes(32)),
+            )
+
+        self.attestation_data_fn = fuzz_attestation_data
+
     # -- submissions ------------------------------------------------------
 
     async def submit_attestation(self, att) -> None:

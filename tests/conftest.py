@@ -17,9 +17,15 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
+    # Canonical flag string — EXACTLY the one __graft_entry__.dryrun_multichip
+    # uses — so pytest and the driver dryrun share persistent-cache entries
+    # for the same programs. Optimization level 0: tests assert
+    # correctness, not speed, and XLA:CPU compile of the pairing programs
+    # is severalfold faster without the LLVM optimization pipeline.
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+        "--xla_force_host_platform_device_count=8"
+        " --xla_backend_optimization_level=0"
+    )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax

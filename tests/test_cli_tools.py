@@ -211,3 +211,80 @@ def test_test_peers_unreachable(capsys):
     )
     assert rc == 1
     assert "unreachable" in capsys.readouterr().out
+
+
+def test_test_validator_and_mev_probes(capsys):
+    """`test validator` / `test mev` hit the service status endpoints
+    (ref: cmd/testvalidator.go, cmd/testmev.go)."""
+    import asyncio
+
+    from aiohttp import web
+
+    async def serve_and_probe():
+        app = web.Application()
+
+        async def ok(request):
+            return web.json_response({"data": {"version": "x"}})
+
+        app.add_routes(
+            [web.get("/eth/v1/node/version", ok),
+             web.get("/eth/v1/builder/status", ok)]
+        )
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        await runner.cleanup()
+        return port
+
+    port = asyncio.run(serve_and_probe())
+    # server shut down: probes must report unreachable, exercising parsing
+    rc = cli.main(
+        ["test", "validator", "--validator-api-url",
+         f"http://127.0.0.1:{port}", "--count", "1"]
+    )
+    assert rc == 1
+    assert "unreachable" in capsys.readouterr().out
+
+
+def test_test_mev_against_live_server(capsys):
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def serve_one():
+        for _ in range(2):
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.recv(4096)
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                b"Connection: close\r\n\r\nok"
+            )
+            conn.close()
+
+    thread = threading.Thread(target=serve_one, daemon=True)
+    thread.start()
+    try:
+        rc = cli.main(
+            ["test", "mev", "--mev-url", f"http://127.0.0.1:{port}",
+             "--count", "2"]
+        )
+    finally:
+        srv.close()
+    assert rc == 0
+    assert "median=" in capsys.readouterr().out
+
+
+def test_test_performance(capsys):
+    rc = cli.main(["test", "performance", "--duration", "0.05"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "disk_write:" in out and "sha256:" in out and "bls_verify_host:" in out
