@@ -10,12 +10,23 @@ the reference executes one herumi C++ call at a time
 core/parsigex/parsigex.go:94-98 peer-sig verify). Here a whole batch runs
 as one XLA program on the accelerator.
 
+Verification kernel: random-linear-combination batch verification
+(ops/pairing.py batched_verify_rlc) — one Miller pair per signature plus
+one shared pair and ONE shared final exponentiation, with 64-bit random
+exponents supplied per run (2^-64 soundness; on a False the caller
+re-runs the per-lane kernel to attribute, exactly the strategy consensus
+clients use for gossip batches). The workload here is all-valid, so the
+batch must verify True.
+
 Budget discipline (round-1 bench timed out, VERDICT Weak #1):
   * the workload is generated on host by the native C++ backend
     (milliseconds) — the device only runs the verify kernel;
-  * ONE kernel is compiled, at one padded shape, after a tiny warmup
+  * ONE kernel is compiled per attempted batch size, after a tiny warmup
     batch; the persistent cache (.jax_cache, primed on this platform)
     makes the steady-state run seconds;
+  * batch sizes are attempted in descending order — a size whose program
+    crashes the TPU compiler (observed at >= 512 lanes for the per-lane
+    kernel) just falls through to the next;
   * every phase heartbeats with elapsed time.
 
 vs_baseline: measured device throughput divided by the single-threaded
@@ -28,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import sys
 import time
 
@@ -35,7 +47,9 @@ import time
 # C++ (the reference's backend): ~1.5 ms => ~666 sigs/sec.
 CPU_REFERENCE_SIGS_PER_SEC = 666.0
 
-BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+BATCHES = [
+    int(b) for b in os.environ.get("BENCH_BATCHES", "1024 512 256").split()
+]
 WARMUP_BATCH = 4
 ITERS = 3
 
@@ -53,7 +67,6 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     hb(f"jax up, devices={jax.devices()}")
 
-    from charon_tpu import tbls
     from charon_tpu.crypto import h2c
     from charon_tpu.crypto.g1g2 import g1_from_bytes, g2_from_bytes
     from charon_tpu.ops import curve as C
@@ -61,6 +74,7 @@ def main() -> None:
     from charon_tpu.ops import pairing as DP
 
     ctx = limb.default_fp_ctx()
+    fr_ctx = limb.default_fr_ctx()
     hb(f"modules imported, ctx={ctx.name}")
 
     # Workload on host via the native C++ backend (ref-equivalent herumi
@@ -79,31 +93,37 @@ def main() -> None:
     msgs_raw = [b"bench-msg-%d" % i for i in range(n_msgs)]
     msg_pts = [h2c.hash_to_g2(m) for m in msgs_raw]
 
-    import random
-
     rng = random.Random(2026)
-    sks = [
-        rng.randrange(1, 2**250).to_bytes(32, "big") for _ in range(BATCH)
-    ]
+    nmax = max(BATCHES)
+    sks = [rng.randrange(1, 2**250).to_bytes(32, "big") for _ in range(nmax)]
     pks = [impl.secret_to_public_key(sk) for sk in sks]
-    sigs = [
-        impl.sign(sk, msgs_raw[i % n_msgs]) for i, sk in enumerate(sks)
-    ]
-    hb(f"host workload built: {BATCH} keys/sigs (native backend)")
+    sigs = [impl.sign(sk, msgs_raw[i % n_msgs]) for i, sk in enumerate(sks)]
+    hb(f"host workload built: {nmax} keys/sigs")
 
     def pack(npack):
         pk = C.g1_pack(ctx, [g1_from_bytes(p) for p in pks[:npack]])
         msg = C.g2_pack(ctx, [msg_pts[i % n_msgs] for i in range(npack)])
         sig = C.g2_pack(ctx, [g2_from_bytes(s) for s in sigs[:npack]])
-        return pk, msg, sig
+        rand = jax.numpy.asarray(
+            limb.ctx_pack(
+                fr_ctx, [rng.randrange(1, 1 << 64) for _ in range(npack)]
+            )
+        )
+        return pk, msg, sig, rand
 
-    state = {"kernel": jax.jit(lambda p, m, s: DP.batched_verify(ctx, p, m, s)),
-             "fallback": False}
+    def make_kernel():
+        return jax.jit(
+            lambda pk, msg, sig, r: DP.batched_verify_rlc(
+                ctx, fr_ctx, pk, msg, sig, r
+            )
+        )
+
+    state = {"kernel": make_kernel(), "fallback": False}
 
     def run_verify(args, label: str):
         """Run the kernel; on the FIRST failure disable the Pallas fast
-        path and retry once on the pure-XLA engine (a second failure is
-        final — there is nothing left to fall back to)."""
+        path and retry once on the pure-XLA engine; re-raise after that
+        so the caller can fall through to a smaller batch."""
         try:
             t = time.perf_counter()
             ok = state["kernel"](*args)
@@ -112,35 +132,51 @@ def main() -> None:
         except Exception as e:
             if state["fallback"]:
                 raise
-            hb(f"{label} failed ({type(e).__name__}: {str(e)[:120]}); retrying without pallas")
+            hb(
+                f"{label} failed ({type(e).__name__}: {str(e)[:120]}); "
+                "retrying without pallas"
+            )
             limb.set_pallas(False)
             state["fallback"] = True
-            state["kernel"] = jax.jit(
-                lambda p, m, s: DP.batched_verify(ctx, p, m, s)
-            )
+            state["kernel"] = make_kernel()
             t = time.perf_counter()
             ok = state["kernel"](*args)
             ok.block_until_ready()
             hb(f"{label} fallback compile+run {time.perf_counter() - t:.1f}s")
-        assert bool(ok.all()), f"{label} verification failed"
+        assert bool(ok), f"{label} batch verification failed"
         return ok
 
-    # tiny warmup shape first: proves the pipeline + persists its kernel
+    # tiny warmup shape first: proves the pipeline end-to-end
     run_verify(pack(WARMUP_BATCH), f"warmup batch={WARMUP_BATCH}")
-    pk, msg, sig = pack(BATCH)
-    run_verify((pk, msg, sig), f"main batch={BATCH}")
-    kernel = state["kernel"]
 
+    batch, packed = None, None
+    for attempt in BATCHES:
+        try:
+            packed = pack(attempt)
+            run_verify(packed, f"main batch={attempt}")
+            batch = attempt
+            break
+        except AssertionError:
+            raise  # verification failing is a correctness bug, not a size issue
+        except Exception as e:
+            hb(
+                f"batch={attempt} unusable ({type(e).__name__}: "
+                f"{str(e)[:100]}); trying smaller"
+            )
+    if batch is None:
+        raise RuntimeError("no batch size compiled successfully")
+
+    kernel = state["kernel"]
     times = []
     for i in range(ITERS):
         t = time.perf_counter()
-        kernel(pk, msg, sig).block_until_ready()
+        kernel(*packed).block_until_ready()
         times.append(time.perf_counter() - t)
         hb(f"iter {i}: {times[-1]:.3f}s")
 
     best = min(times)
-    sigs_per_sec = BATCH / best
-    hb(f"best {best:.3f}s -> {sigs_per_sec:.0f} sigs/sec")
+    sigs_per_sec = batch / best
+    hb(f"batch={batch} best {best:.3f}s -> {sigs_per_sec:.0f} sigs/sec")
     print(
         json.dumps(
             {

@@ -93,10 +93,16 @@ class InstrumentedClient:
     registry."""
 
     def __init__(self, client: Any, metrics=None, name: str = "beacon") -> None:
+        from collections import deque
+
         self._client = client
         self._metrics = metrics
         self._name = name
-        self.latency: dict[str, list[float]] = defaultdict(list)
+        # bounded: full history lives in the Prometheus histogram; this
+        # window only serves in-process diagnostics
+        self.latency: dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=1024)
+        )
         self.error_count: dict[str, int] = defaultdict(int)
 
     def __getattr__(self, name: str):
@@ -223,7 +229,9 @@ class SyntheticProposerClient:
         self._client = client
         self.slots_per_epoch = slots_per_epoch
         self.synthetic_submitted = 0
-        self._synth_slots: set[int] = set()  # slots WE fabricated duties for
+        # epoch -> slots WE fabricated duties for; trimmed so a
+        # long-lived node doesn't accumulate past epochs forever
+        self._synth_by_epoch: dict[int, set[int]] = {}
 
     def _synth_slot(self, epoch: int, pubkey: bytes) -> int:
         import hashlib
@@ -243,12 +251,17 @@ class SyntheticProposerClient:
             if isinstance(validators, dict)
             else [(v, i) for i, v in enumerate(validators)]
         )
+        slots = self._synth_by_epoch.setdefault(epoch, set())
+        # keep a small window of epochs (proposals only query duties
+        # around the current epoch)
+        for old in [e for e in self._synth_by_epoch if e < epoch - 2]:
+            del self._synth_by_epoch[old]
         for pk, vidx in items:
             if pk in have:
                 continue
             raw = pk if isinstance(pk, bytes) else str(pk).encode()
             slot = self._synth_slot(epoch, raw)
-            self._synth_slots.add(slot)
+            slots.add(slot)
             real.append(
                 {
                     "pubkey": pk,
@@ -260,7 +273,7 @@ class SyntheticProposerClient:
         return real
 
     async def block_proposal(self, slot: int, *args, randao_reveal=None, graffiti=None, **kw):
-        if slot in self._synth_slots:
+        if any(slot in s for s in self._synth_by_epoch.values()):
             # ONLY slots we fabricated duties for get synthetic blocks; a
             # transient BN failure on a real duty must propagate so the
             # retryer can re-fetch it (ref: synthproposer.go consults its

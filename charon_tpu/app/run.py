@@ -265,6 +265,9 @@ async def build_node(config: Config) -> Node:
     )
     aggsigdb = AggSigDB()
     bcast = Broadcaster(beacon=beacon, clock=clock)
+    # lock-file registrations re-broadcast every epoch by the recaster
+    # (ref: app/app.go:676-743 wireRecaster pre-generate path)
+    bcast.load_pregen_registrations(lock.validators)
     fetcher = Fetcher(beacon)
     # Per-message k1 auth: every consensus message (and each piggybacked
     # justification) is signed/verified against the operators' keys
@@ -316,6 +319,9 @@ async def build_node(config: Config) -> Node:
         _make_expiry(dutydb, parsigdb, aggsigdb, tracker, qbft_consensus),
     )
     scheduler.subscribe_duties(_register_deadline(deadliner))
+    # recaster: re-broadcast VC + lock-file registrations once per epoch
+    # (ref: app/app.go:676-743 wireRecaster subscribes to slots)
+    scheduler.subscribe_slots(bcast.recast)
 
     # inclusion checker: broadcast duties must land on-chain within 32
     # slots (ref: core/tracker/inclusion.go, wiring app/app.go:746-780)

@@ -82,6 +82,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dkgp.add_argument("--timeout", type=float, default=120.0)
     dkgp.add_argument("--no-tpu", action="store_true")
+    dkgp.add_argument(
+        "--keymanager-address",
+        default="",
+        help="push share keystores to this VC keymanager API after the "
+        "ceremony (ref: dkg.go:118-128)",
+    )
+    dkgp.add_argument(
+        "--publish-address",
+        default="",
+        help="publish the cluster lock to this Obol-API endpoint "
+        "(ref: dkg.go obolapi publish)",
+    )
 
     cenr = sub.add_parser(
         "create-enr",
@@ -373,6 +385,32 @@ def cmd_dkg(args) -> int:
         )
     )
     print(f"dkg complete; lock hash: 0x{result.lock.lock_hash().hex()}")
+
+    if args.keymanager_address:
+        # push share keystores into the operator's VC
+        # (ref: dkg.go:118-128 keymanager import; eth2util/keymanager)
+        from charon_tpu.eth2util.keymanager import KeymanagerClient
+
+        keys_dir = Path(args.data_dir) / "validator_keys"
+        keystores, passwords = [], []
+        i = 0
+        while (keys_dir / f"keystore-{i}.json").exists():
+            keystores.append(
+                json.loads((keys_dir / f"keystore-{i}.json").read_text())
+            )
+            passwords.append(
+                (keys_dir / f"keystore-{i}.txt").read_text().strip()
+            )
+            i += 1
+        client = KeymanagerClient(args.keymanager_address)
+        asyncio.run(client.import_keystores(keystores, passwords))
+        print(f"pushed {len(keystores)} keystores to keymanager")
+
+    if args.publish_address:
+        from charon_tpu.app.obolapi import ObolApiClient
+
+        asyncio.run(ObolApiClient(args.publish_address).publish_lock(result.lock))
+        print("lock published")
     return 0
 
 

@@ -42,7 +42,11 @@ class Broadcaster:
                 pass  # randao is an input to proposals, never broadcast
             elif duty.type == DutyType.BUILDER_REGISTRATION:
                 await self.beacon.submit_registration(signed.payload, signed.signature)
-                self._registrations[duty] = data_set  # for the recaster
+                # merge per pubkey — separate submissions share the duty
+                # key (slot 0), and the recaster needs all of them
+                merged = dict(self._registrations.get(duty, {}))
+                merged.update(data_set)
+                self._registrations[duty] = merged
             elif duty.type == DutyType.EXIT:
                 await self.beacon.submit_exit(signed.payload, signed.signature)
             elif duty.type == DutyType.AGGREGATOR:
@@ -80,14 +84,61 @@ class Broadcaster:
 
         return replace(signed.payload, signature=signed.signature)
 
+    def load_pregen_registrations(self, validators) -> int:
+        """Load the lock file's pre-generated builder registrations so the
+        recaster re-broadcasts them even when no VC ever submits one
+        (ref: core/bcast/recast.go pre-generate path — lock-file
+        registrations signed during the DKG, dkg.go:190-194).
+
+        `validators`: the lock's DistributedValidator entries. Returns the
+        number loaded."""
+        from charon_tpu.eth2util import registration as regmod
+
+        loaded = 0
+        self._pregen: list[tuple[object, bytes]] = []
+        for dv in validators:
+            obj = getattr(dv, "builder_registration", None) or {}
+            if not obj.get("message"):
+                continue
+            reg, sig = regmod.from_lock_json(obj)
+            self._pregen.append((reg, sig))
+            loaded += 1
+        return loaded
+
     async def recast(self, slot) -> None:
         """Re-broadcast validator registrations every epoch
         (ref: core/bcast/recast.go Recaster; wiring app/app.go:677-743).
-        Subscribe to scheduler slots."""
+        Subscribe to scheduler slots.
+
+        Failures are contained: the scheduler's slot loop has no
+        exception isolation, and a transient BN outage at an epoch
+        boundary must not kill duty scheduling (the reference's recaster
+        logs and carries on)."""
         if slot.slot % slot.slots_per_epoch != 0:
             return
-        for duty, data_set in list(self._registrations.items()):
-            for pubkey, signed in data_set.items():
-                await self.beacon.submit_registration(
-                    signed.payload, signed.signature
-                )
+        try:
+            for duty, data_set in list(self._registrations.items()):
+                for pubkey, signed in data_set.items():
+                    await self.beacon.submit_registration(
+                        signed.payload, signed.signature
+                    )
+            # pre-generated registrations from the lock: skip any pubkey
+            # the VC has submitted a fresher registration for
+            submitted = {
+                getattr(signed.payload, "pubkey", None)
+                for ds in self._registrations.values()
+                for signed in ds.values()
+            }
+            for reg, sig in getattr(self, "_pregen", []):
+                if reg.pubkey in submitted:
+                    continue
+                await self.beacon.submit_registration(reg, sig)
+        except Exception as e:  # noqa: BLE001 — log-and-continue
+            from charon_tpu.app import log
+
+            log.warn(
+                "registration recast failed",
+                topic="bcast",
+                slot=slot.slot,
+                err=str(e),
+            )

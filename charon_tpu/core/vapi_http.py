@@ -376,12 +376,14 @@ class VapiRouter:
             ) as resp:
                 body = await resp.read()
                 # forward end-to-end response headers: the VC needs e.g.
-                # Eth-Consensus-Version to decode fork-aware bodies
+                # Eth-Consensus-Version to decode fork-aware bodies.
+                # content-encoding is dropped too: aiohttp has already
+                # decompressed the body we are about to send verbatim
                 headers = {
                     k: v
                     for k, v in resp.headers.items()
                     if k.lower() not in self._HOP_HEADERS
-                    and k.lower() != "content-type"
+                    and k.lower() not in ("content-type", "content-encoding")
                 }
                 return web.Response(
                     status=resp.status,

@@ -81,7 +81,11 @@ async def run_dkg(
         frost_port, share_idx, n, t, v, ctx, engine=engine
     )
 
-    # 2. Build the (unsigned) lock.
+    # 2. Build the (to-be-sealed) validator entries. Deposits and builder
+    # registrations are signed and patched in BEFORE the lock hash is
+    # computed — the lock hash covers them (ref: dkg.go runs the
+    # exchanger's deposit/registration sig rounds first and the lock-hash
+    # sig round last, dkg.go:190-194).
     validators = tuple(
         DistributedValidator(
             distributed_public_key="0x" + g1_to_bytes(r.group_pubkey).hex(),
@@ -92,40 +96,11 @@ async def run_dkg(
         )
         for r in results
     )
-    lock = ClusterLock(definition=defn, validators=validators)
-    lock_hash = lock.lock_hash()
-
-    # 3. Exchange partial signatures over the lock hash: every node signs
-    # with each validator's share key (ref: dkg/exchanger.go sigLock).
     share_secrets = [
         (r.secret_share % (1 << 256)).to_bytes(32, "big") for r in results
     ]
-    my_partials = [
-        tbls.sign(share_secrets[i], lock_hash) for i in range(v)
-    ]
-    all_partials = await exchange_port.exchange(
-        "lock-sig", [s.hex() for s in my_partials]
-    )
 
-    # 4. Threshold-aggregate each validator's group signature, then
-    # BLS-aggregate across validators (ref: lock signature_aggregate).
-    group_sigs = tbls.threshold_aggregate_batch(
-        [
-            {
-                peer + 1: bytes.fromhex(all_partials[peer][i])
-                for peer in sorted(all_partials)
-            }
-            for i in range(v)
-        ]
-    )
-    sig_agg = tbls.aggregate(group_sigs)
-    tbls.verify_aggregate(
-        [bytes.fromhex(dv.distributed_public_key[2:]) for dv in validators],
-        lock_hash,
-        sig_agg,
-    )
-
-    # 4b. Deposit data: threshold-sign each validator's deposit message
+    # 2b. Deposit data: threshold-sign each validator's deposit message
     # (ref: dkg/exchanger.go sigDepositData — partials exchanged and
     # aggregated exactly like the lock signature).
     from charon_tpu.eth2util import deposit as dep
@@ -174,6 +149,102 @@ async def run_dkg(
                 signature=sig,
             )
         )
+
+    import json as _json
+    from dataclasses import replace as _replace
+
+    validators = tuple(
+        _replace(
+            dv,
+            deposit_data=_json.loads(
+                dep.deposit_data_json([d], fork_version, defn.name)
+            )[0],
+        )
+        for dv, d in zip(validators, deposits)
+    )
+
+    # 2c. Pre-generated builder registrations: threshold-sign a default
+    # ValidatorRegistration per validator so the node can re-broadcast
+    # them every epoch without a VC (ref: dkg.go:190-194 sigTypes include
+    # registrations; core/bcast/recast.go consumes them from the lock).
+    from charon_tpu.eth2util import network as networks
+    from charon_tpu.eth2util import registration as regmod
+    from charon_tpu.eth2util.signing import ForkInfo as _ForkInfo
+
+    fee_recipient = bytes(20)
+    if getattr(defn, "fee_recipient_address", ""):
+        raw = defn.fee_recipient_address
+        fee_recipient = bytes.fromhex(raw[2:] if raw.startswith("0x") else raw)
+    reg_fork = _ForkInfo(
+        genesis_validators_root=bytes(32),
+        fork_version=fork_version,
+        genesis_fork_version=fork_version,
+    )
+    reg_msgs = [
+        regmod.ValidatorRegistration(
+            fee_recipient=fee_recipient,
+            gas_limit=regmod.DEFAULT_GAS_LIMIT,
+            timestamp=networks.genesis_time(fork_version, default=0),
+            pubkey=bytes.fromhex(dv.distributed_public_key[2:]),
+        )
+        for dv in validators
+    ]
+    reg_roots = [regmod.signing_root(m, reg_fork) for m in reg_msgs]
+    my_reg_partials = [
+        tbls.sign(share_secrets[i], reg_roots[i]) for i in range(v)
+    ]
+    all_reg = await exchange_port.exchange(
+        "registration-sig", [s.hex() for s in my_reg_partials]
+    )
+    reg_sigs = tbls.threshold_aggregate_batch(
+        [
+            {
+                peer + 1: bytes.fromhex(all_reg[peer][i])
+                for peer in sorted(all_reg)
+            }
+            for i in range(v)
+        ]
+    )
+    patched = []
+    for dv, msg, sig, root in zip(validators, reg_msgs, reg_sigs, reg_roots):
+        tbls.verify(
+            bytes.fromhex(dv.distributed_public_key[2:]), root, sig
+        )
+        patched.append(
+            _replace(
+                dv, builder_registration=regmod.to_lock_json(msg, sig)
+            )
+        )
+    validators = tuple(patched)
+
+    # 3. The lock hash seals everything above. Exchange partial
+    # signatures over it: every node signs with each validator's share
+    # key (ref: dkg/exchanger.go sigLock — the LAST sig round).
+    lock_hash = ClusterLock(definition=defn, validators=validators).lock_hash()
+    my_partials = [
+        tbls.sign(share_secrets[i], lock_hash) for i in range(v)
+    ]
+    all_partials = await exchange_port.exchange(
+        "lock-sig", [s.hex() for s in my_partials]
+    )
+
+    # 4. Threshold-aggregate each validator's group signature, then
+    # BLS-aggregate across validators (ref: lock signature_aggregate).
+    group_sigs = tbls.threshold_aggregate_batch(
+        [
+            {
+                peer + 1: bytes.fromhex(all_partials[peer][i])
+                for peer in sorted(all_partials)
+            }
+            for i in range(v)
+        ]
+    )
+    sig_agg = tbls.aggregate(group_sigs)
+    tbls.verify_aggregate(
+        [bytes.fromhex(dv.distributed_public_key[2:]) for dv in validators],
+        lock_hash,
+        sig_agg,
+    )
 
     # 5. Per-node k1 signatures over the lock hash
     # (ref: dkg/nodesigs.go via the reliable-broadcast component).

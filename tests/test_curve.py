@@ -11,6 +11,9 @@ from charon_tpu.crypto.fields import R
 from charon_tpu.ops import curve as C
 from charon_tpu.ops import limb
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 rng = random.Random(7)
 
 

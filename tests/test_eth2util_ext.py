@@ -199,3 +199,45 @@ def test_deposit_message_root_spec_shape():
     amount_chunk = amount.to_bytes(8, "little") + bytes(24)
     want = sha(sha(pk_root, creds), sha(amount_chunk, bytes(32)))
     assert msg.hash_tree_root() == want
+
+
+def test_keymanager_import_keystores():
+    """KeymanagerClient pushes EIP-2335 keystores to a VC keymanager API
+    (ref: eth2util/keymanager keymanager.go ImportKeystores)."""
+    import asyncio
+    import json as _json
+
+    from aiohttp import web
+
+    from charon_tpu.eth2util.keymanager import KeymanagerClient
+
+    received = {}
+
+    async def main():
+        app = web.Application()
+
+        async def import_handler(request):
+            received.update(await request.json())
+            n = len(received["keystores"])
+            return web.json_response(
+                {"data": [{"status": "imported"} for _ in range(n)]}
+            )
+
+        app.add_routes([web.post("/eth/v1/keystores", import_handler)])
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            client = KeymanagerClient(f"http://127.0.0.1:{port}")
+            statuses = await client.import_keystores(
+                [{"crypto": {}, "pubkey": "aa"}], ["pw"]
+            )
+            assert statuses[0]["status"] == "imported"
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
+    assert _json.loads(received["keystores"][0])["pubkey"] == "aa"
+    assert received["passwords"] == ["pw"]

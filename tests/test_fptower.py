@@ -15,6 +15,9 @@ from charon_tpu.crypto import fields as F
 from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 rng = random.Random(99)
 
 

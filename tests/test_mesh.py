@@ -18,6 +18,9 @@ from charon_tpu.crypto import bls, h2c, shamir
 from charon_tpu.crypto.fields import R
 from charon_tpu.parallel import SlotCryptoPlane, make_mesh
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 T = 3
 
 

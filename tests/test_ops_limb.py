@@ -8,6 +8,9 @@ import jax.numpy as jnp
 from charon_tpu.crypto.fields import P, R
 from charon_tpu.ops import limb
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 rng = random.Random(1234)
 
 

@@ -23,6 +23,9 @@ from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 rng = random.Random(31)
 CTX = limb.FP
 

@@ -1,0 +1,74 @@
+"""Random-linear-combination batch verification vs the per-lane kernel
+(ops/pairing.py batched_verify_rlc): all-valid batches accept, any forged
+lane rejects (soundness comes from the caller's random exponents)."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from charon_tpu.crypto import bls, h2c
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import limb
+from charon_tpu.ops import pairing as DP
+
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
+N = 5  # deliberately not a power of two: exercises the pad paths
+
+
+def _workload(forge_lane=None):
+    ctx = limb.default_fp_ctx()
+    sks = [bls.keygen(bytes([i + 1]) * 32) for i in range(N)]
+    msgs = [b"rlc-%d" % i for i in range(N)]
+    msg_pts = [h2c.hash_to_g2(m) for m in msgs]
+    sigs = [bls.sign(sk, m) for sk, m in zip(sks, msgs)]
+    if forge_lane is not None:
+        # signature over a different message: a per-lane forgery
+        sigs[forge_lane] = bls.sign(sks[forge_lane], b"forged")
+    pk = C.g1_pack(ctx, [bls.sk_to_pk(sk) for sk in sks])
+    msg = C.g2_pack(ctx, msg_pts)
+    sig = C.g2_pack(ctx, sigs)
+    return ctx, pk, msg, sig
+
+
+def _rand(fr_ctx, seed=7):
+    rng = random.Random(seed)
+    return jax.numpy.asarray(
+        limb.ctx_pack(
+            fr_ctx, [rng.randrange(1, 1 << 64) for _ in range(N)]
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def kernel():
+    fr_ctx = limb.default_fr_ctx()
+    fp_ctx = limb.default_fp_ctx()
+    return jax.jit(
+        lambda pk, msg, sig, r: DP.batched_verify_rlc(
+            fp_ctx, fr_ctx, pk, msg, sig, r
+        )
+    )
+
+
+def test_rlc_accepts_valid_batch(kernel):
+    ctx, pk, msg, sig = _workload()
+    assert bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
+
+
+def test_rlc_rejects_forged_lane(kernel):
+    ctx, pk, msg, sig = _workload(forge_lane=3)
+    assert not bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
+
+
+def test_rlc_rejects_wrong_pubkey(kernel):
+    ctx, pk, msg, sig = _workload()
+    # swap two pubkeys: messages no longer match their signers
+    swapped = jax.tree_util.tree_map(
+        lambda a: a.at[0].set(a[1]).at[1].set(a[0]), pk
+    )
+    assert not bool(kernel(swapped, msg, sig, _rand(limb.default_fr_ctx())))

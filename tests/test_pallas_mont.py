@@ -14,6 +14,9 @@ import pytest
 from charon_tpu.ops import limb
 from charon_tpu.ops.pallas_mont import mont_mul_pallas
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 
 @pytest.mark.parametrize("ctx", [limb.FP32, limb.FR32], ids=["fp32", "fr32"])
 def test_pallas_matches_jnp_and_host(ctx):

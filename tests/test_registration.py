@@ -1,0 +1,163 @@
+"""Builder registrations: SSZ root, builder-domain signing, lock JSON
+round-trip, DKG-produced lock registrations, recaster pre-gen broadcast
+(ref: eth2util/registration, core/bcast/recast.go, dkg.go:190-194)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.eth2util import network as networks
+from charon_tpu.eth2util import registration as regmod
+from charon_tpu.eth2util.signing import DomainName, ForkInfo
+from charon_tpu.tbls.python_impl import PythonImpl
+
+
+@pytest.fixture(autouse=True)
+def host_tbls():
+    try:
+        from charon_tpu.tbls.native_impl import NativeImpl
+
+        tbls.set_implementation(NativeImpl())
+    except ImportError:
+        tbls.set_implementation(PythonImpl())
+    yield
+    tbls.set_implementation(PythonImpl())
+
+
+def _reg(pubkey=b"\xaa" * 48):
+    return regmod.ValidatorRegistration(
+        fee_recipient=b"\x01" * 20,
+        gas_limit=regmod.DEFAULT_GAS_LIMIT,
+        timestamp=networks.by_name("mainnet").genesis_time,
+        pubkey=pubkey,
+    )
+
+
+def test_registration_root_deterministic_and_field_sensitive():
+    a, b = _reg(), _reg()
+    assert a.hash_tree_root() == b.hash_tree_root()
+    c = regmod.ValidatorRegistration(
+        fee_recipient=b"\x02" * 20,
+        gas_limit=a.gas_limit,
+        timestamp=a.timestamp,
+        pubkey=a.pubkey,
+    )
+    assert a.hash_tree_root() != c.hash_tree_root()
+
+
+def test_signing_root_uses_builder_domain():
+    fork = ForkInfo(
+        genesis_validators_root=b"\x11" * 32,
+        fork_version=b"\x01\x00\x00\x00",
+        genesis_fork_version=b"\x00\x00\x00\x00",
+    )
+    reg = _reg()
+    root = regmod.signing_root(reg, fork)
+    # builder domain ignores the current fork + validators root: a fork
+    # change must NOT change the root (genesis fork version pins it)
+    fork2 = ForkInfo(
+        genesis_validators_root=b"\x22" * 32,
+        fork_version=b"\x02\x00\x00\x00",
+        genesis_fork_version=b"\x00\x00\x00\x00",
+    )
+    assert regmod.signing_root(reg, fork2) == root
+
+
+def test_lock_json_roundtrip_and_signature():
+    sk = tbls.generate_secret_key()
+    pk = tbls.secret_to_public_key(sk)
+    reg = _reg(pubkey=pk)
+    fork = ForkInfo(bytes(32), b"\x00" * 4, b"\x00" * 4)
+    sig = tbls.sign(sk, regmod.signing_root(reg, fork))
+    obj = regmod.to_lock_json(reg, sig)
+    reg2, sig2 = regmod.from_lock_json(obj)
+    assert reg2 == reg and sig2 == sig
+    tbls.verify(pk, regmod.signing_root(reg2, fork), sig2)
+
+
+def test_network_registry():
+    assert networks.by_name("mainnet").genesis_time == 1_606_824_023
+    assert networks.by_fork_version("0x00000000").name == "mainnet"
+    assert networks.by_fork_version(b"\x90\x00\x00\x69").name == "sepolia"
+    assert networks.genesis_time("0xdeadbeef", default=7) == 7
+    with pytest.raises(ValueError):
+        networks.by_name("nope")
+
+
+def test_dkg_lock_carries_signed_registrations_and_deposits():
+    from charon_tpu.app import k1util
+    from charon_tpu.cluster import ClusterDefinition, Operator
+    from charon_tpu.dkg import frost
+    from charon_tpu.dkg.ceremony import MemExchangeNet, run_dkg
+
+    n, t, v = 3, 2, 2
+    keys = [k1util.generate_private_key() for _ in range(n)]
+    ops = tuple(
+        Operator(address=f"0xop{i}", enr=f"enr:-node-{i}") for i in range(n)
+    )
+    defn = ClusterDefinition(
+        name="regtest",
+        num_validators=v,
+        threshold=t,
+        fork_version="0x00000000",
+        operators=ops,
+        uuid="fixed-uuid",
+        timestamp="2026-07-30T00:00:00Z",
+    )
+    for i in range(n):
+        defn = defn.sign_operator(i, keys[i])
+
+    async def ceremony():
+        fnet, enet = frost.MemFrostTransport(n), MemExchangeNet(n)
+        return await asyncio.gather(
+            *(
+                run_dkg(defn, i, keys[i], fnet.participant(i + 1), enet.port(i))
+                for i in range(n)
+            )
+        )
+
+    results = asyncio.run(ceremony())
+    locks = [r.lock for r in results]
+    # identical locks across nodes, sealed over the registration-carrying
+    # validators (lock.verify recomputes the hash from file content)
+    assert len({l.lock_hash() for l in locks}) == 1
+    locks[0].verify()
+    fork = ForkInfo(bytes(32), b"\x00" * 4, b"\x00" * 4)
+    for dv in locks[0].validators:
+        reg, sig = regmod.from_lock_json(dv.builder_registration)
+        assert reg.pubkey.hex() == dv.distributed_public_key[2:]
+        assert reg.timestamp == networks.by_name("mainnet").genesis_time
+        tbls.verify(reg.pubkey, regmod.signing_root(reg, fork), sig)
+        assert dv.deposit_data["pubkey"] == dv.distributed_public_key[2:]
+
+
+def test_recaster_broadcasts_pregen_registrations():
+    from charon_tpu.core.bcast import Broadcaster
+    from charon_tpu.testutil.beaconmock import BeaconMock
+
+    sk = tbls.generate_secret_key()
+    pk = tbls.secret_to_public_key(sk)
+    reg = _reg(pubkey=pk)
+    fork = ForkInfo(bytes(32), b"\x00" * 4, b"\x00" * 4)
+    sig = tbls.sign(sk, regmod.signing_root(reg, fork))
+
+    class DV:
+        builder_registration = regmod.to_lock_json(reg, sig)
+
+    beacon = BeaconMock(slots_per_epoch=4)
+    bcast = Broadcaster(beacon=beacon)
+    assert bcast.load_pregen_registrations([DV()]) == 1
+
+    class Slot:
+        slot = 8
+        slots_per_epoch = 4
+
+    asyncio.run(bcast.recast(Slot()))
+    assert len(beacon.registrations) == 1
+    got_reg, got_sig = beacon.registrations[0]
+    assert got_reg.pubkey == pk and got_sig == sig
+    # non-epoch-start slots do nothing
+    Slot.slot = 9
+    asyncio.run(bcast.recast(Slot()))
+    assert len(beacon.registrations) == 1

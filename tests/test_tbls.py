@@ -11,6 +11,9 @@ from charon_tpu import tbls
 from charon_tpu.tbls.python_impl import PythonImpl
 from charon_tpu.tbls.tpu_impl import TPUImpl
 
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
 rng = random.Random(5)
 
 N, T = 4, 3
