@@ -87,15 +87,19 @@ def main() -> None:
             group_pks[:v],
             [row[:t] for row in indices[:v]],
         )
+        rand = plane.make_rand(v, rng=random.Random(7))
         ts = time.perf_counter()
-        _, ok, total = plane.step(*args)
-        total.block_until_ready()
-        hb(f"V={v} t={t} compile+run {time.perf_counter() - ts:.1f}s ok={int(total)}/{v}")
-        assert int(total) == v, f"slot step failed: {int(total)}/{v}"
+        _, all_ok = plane.step_rlc(*args, rand)
+        all_ok.block_until_ready()
+        hb(
+            f"V={v} t={t} compile+run {time.perf_counter() - ts:.1f}s "
+            f"all_ok={bool(all_ok)}"
+        )
+        assert bool(all_ok), f"slot step failed at V={v}"
         times = []
         for _ in range(3):
             ts = time.perf_counter()
-            plane.step(*args)[2].block_until_ready()
+            plane.step_rlc(*args, rand)[1].block_until_ready()
             times.append(time.perf_counter() - ts)
         best = min(times)
         per_slot = best

@@ -59,6 +59,7 @@ class SlotCryptoPlane:
         self.fr_ctx = fr_ctx or limb.default_fr_ctx()
         self.axis = mesh.axis_names[0]
         self._step = self._build()
+        self._step_rlc = self._build_rlc()
 
     def _build(self):
         ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
@@ -104,6 +105,86 @@ class SlotCryptoPlane:
             out_specs=(P(axis), P(axis), P()),
         )
         return jax.jit(sharded)
+
+    def _build_rlc(self):
+        """The throughput path: identical recombination, but verification
+        by random linear combination (ops/pairing.batched_verify_rlc
+        design) — each shard product-trees its lanes' pairing values and
+        runs ONE local final exponentiation (all shards in parallel), so
+        the per-lane final-exp cost disappears. Returns (group_sig,
+        all_ok) where all_ok is the cluster-wide AND (psum of per-shard
+        failures == 0). Per-lane attribution on failure comes from the
+        slower `step` (the reference pays per-signature herumi calls for
+        every duty; here the common all-valid case costs one shared tail
+        per shard — core/sigagg/sigagg.go:84-122)."""
+        ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
+        g2f = C.g2_ops(ctx)
+
+        def local_step(pubshares, msg, partials, group_pk, indices, live, rand):
+            coeffs = blsops.lagrange_coeffs_at_zero(fr_ctx, indices, t)
+            proj = C.affine_to_point(g2f, partials)
+            scaled = C.point_scalar_mul(g2f, fr_ctx, proj, coeffs)
+            group_sig = C.point_to_affine(
+                g2f, C.point_sum(g2f, scaled, axis=-1)
+            )
+
+            cat = lambda a, b: jnp.concatenate(
+                (a, b[:, None, ...]), axis=1
+            ).reshape(-1, *a.shape[2:])
+            pk_all = jax.tree_util.tree_map(cat, pubshares, group_pk)
+            sig_all = jax.tree_util.tree_map(cat, partials, group_sig)
+            msg_rep = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, t + 1, axis=0), msg
+            )
+            # padding lanes carry live=False: zero their random exponent
+            # so their (possibly garbage) pairing value contributes ^0=1.
+            # (point * 0 = identity -> masked to the neutral line.)
+            rand_rep = jnp.repeat(
+                jnp.where(live[:, None], rand, 0), t + 1, axis=0
+            )
+            ok = DP.batched_verify_rlc(
+                ctx, fr_ctx, pk_all, msg_rep, sig_all, rand_rep
+            )
+            bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
+            return group_sig, bad == 0
+
+        sharded = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)
+            ),
+            out_specs=(P(axis), P()),
+        )
+        return jax.jit(sharded)
+
+    def step_rlc(self, pubshares, msg, partials, group_pk, indices, live, rand):
+        """Fast path: (group_sig, all_ok). `rand` is a [V] raw Fr limb
+        array of nonzero 64-bit exponents (host randomness)."""
+        return self._step_rlc(
+            pubshares, msg, partials, group_pk, indices, live, rand
+        )
+
+    def make_rand(self, v: int, rng=None) -> jnp.ndarray:
+        """[V_padded] nonzero 64-bit exponents packed as raw Fr limbs."""
+        import random as _random
+
+        rng = rng or _random.Random()
+        shards = self.shard_count()
+        vp = v + ((-v) % shards)
+        return jnp.asarray(
+            np.asarray(
+                [
+                    limb.int_to_limbs(
+                        rng.randrange(1, 1 << 64),
+                        self.fr_ctx.n_limbs,
+                        self.fr_ctx.limb_bits,
+                        self.fr_ctx.np_dtype,
+                    )
+                    for _ in range(vp)
+                ]
+            )
+        )
 
     # -- host-facing ------------------------------------------------------
 

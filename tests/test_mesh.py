@@ -101,3 +101,45 @@ def test_all_invalid(plane):
     )
     assert ok == [False] * v
     assert total == 0
+
+
+def test_step_rlc_all_valid_and_forged(plane):
+    """RLC fast path: all-valid slot accepts with ONE final exp per
+    shard; a forged partial flips the cluster-wide bool (attribution
+    then comes from the per-lane step)."""
+    v = 8
+    pubshares, msgs, partials, group_pks, indices = _workload(v)
+    args = plane.pack_inputs(pubshares, msgs, partials, group_pks, indices)
+    rand = plane.make_rand(v, rng=random.Random(42))
+    group_sig, all_ok = plane.step_rlc(*args, rand)
+    assert bool(all_ok)
+    # recombined signatures identical to the per-lane path's
+    from charon_tpu.ops import curve as C
+
+    sigs = C.g2_unpack(plane.ctx, group_sig)[:v]
+    for lane in range(v):
+        want = shamir.threshold_aggregate_g2(
+            dict(zip(indices[lane], partials[lane]))
+        )
+        assert sigs[lane] == want
+
+    # forge one partial: signature over a different message
+    det = random.Random(1000 + 3)
+    sk = bls.keygen(bytes([4]) * 32)
+    shares = shamir.split(sk, T + 1, T, rand=lambda: det.randrange(1, R))
+    partials_bad = [list(row) for row in partials]
+    partials_bad[3][1] = bls.sign(shares[sorted(shares)[1]], b"forged")
+    args_bad = plane.pack_inputs(
+        pubshares, msgs, partials_bad, group_pks, indices
+    )
+    _, all_ok_bad = plane.step_rlc(*args_bad, rand)
+    assert not bool(all_ok_bad)
+
+
+def test_step_rlc_padding_lanes_ignored(plane):
+    v = 5
+    pubshares, msgs, partials, group_pks, indices = _workload(v)
+    args = plane.pack_inputs(pubshares, msgs, partials, group_pks, indices)
+    rand = plane.make_rand(v, rng=random.Random(7))
+    _, all_ok = plane.step_rlc(*args, rand)
+    assert bool(all_ok)
