@@ -56,6 +56,7 @@ class Config:
     node_index: int  # 0-based operator index
     p2p_host: str = "127.0.0.1"
     p2p_port: int = 0
+    relay_addr: str = ""  # host:port of a charon-tpu relay (NAT fallback)
     validator_api_port: int = 0
     monitoring_port: int = 0
     peer_addrs: list[tuple[str, int]] = field(default_factory=list)
@@ -230,8 +231,20 @@ async def build_node(config: Config) -> Node:
             # operator ENR field carries the k1 pubkey hex in this format
             pub = enr.pubkey_from_string(lock.definition.operators[i].enr)
             specs.append(PeerSpec(index=i, pubkey=pub, host=host, port=port))
+        relay_client = None
+        if config.relay_addr:
+            # NAT fallback: unreachable peers are dialed through the
+            # relay with the same end-to-end handshake (ref:
+            # app/app.go:307-356 wires relays into the libp2p host)
+            from charon_tpu.p2p.relay import RelayClient
+
+            rhost, rport = config.relay_addr.rsplit(":", 1)
+            relay_client = RelayClient(
+                rhost, int(rport), lock.lock_hash(), config.node_index
+            )
         p2p_node = P2PNode(
-            config.node_index, k1_key, specs, lock.lock_hash()
+            config.node_index, k1_key, specs, lock.lock_hash(),
+            relay=relay_client,
         )
         await p2p_node.start()
         qbft_net = TcpQbftNet(p2p_node)

@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     runp.add_argument("--no-tpu", action="store_true", help="use the pure-python tbls backend")
     runp.add_argument(
+        "--relay",
+        default=_env_default("relay", ""),
+        help="host:port of a charon-tpu relay for NAT fallback dials",
+    )
+    runp.add_argument(
         "--beacon-urls",
         default=_env_default("beacon-urls", ""),
         help="comma-separated beacon-node HTTP endpoints (failover order)",
@@ -299,6 +304,7 @@ def cmd_run(args) -> int:
         slots_per_epoch=args.slots_per_epoch,
         genesis_time=args.genesis_time,
         use_tpu_tbls=not args.no_tpu,
+        relay_addr=args.relay,
     )
     asyncio.run(run(config))
     return 0

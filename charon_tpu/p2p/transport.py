@@ -105,12 +105,14 @@ class P2PNode:
         privkey,
         peers: list[PeerSpec],
         cluster_hash: bytes,
+        relay=None,  # p2p.relay.RelayClient for NAT fallback
     ) -> None:
         self.index = index
         self.key = privkey
         self.peers = {p.index: p for p in peers if p.index != index}
         self.self_spec = next(p for p in peers if p.index == index)
         self.cluster_hash = cluster_hash
+        self.relay = relay
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[int, _Conn] = {}
         self._handlers: dict[str, Callable] = {}
@@ -127,8 +129,29 @@ class P2PNode:
             self._on_inbound, self.self_spec.host, self.self_spec.port
         )
         self.register_handler("ping", self._handle_ping)
+        if self.relay is not None:
+            # inbound relayed streams get the normal responder handshake.
+            # A dead relay degrades to direct-only dialing — a FALLBACK
+            # must never make startup depend on it.
+            self.relay.set_stream_acceptor(self._on_relay_stream)
+            try:
+                await self.relay.connect()
+            except OSError as e:
+                from charon_tpu.app import log
+
+                log.warn(
+                    "relay unreachable; direct-only p2p",
+                    topic="p2p",
+                    err=str(e),
+                )
+                self.relay = None
+
+    async def _on_relay_stream(self, peer_idx: int, reader, writer) -> None:
+        await self._on_inbound(reader, writer)
 
     async def stop(self) -> None:
+        if self.relay is not None:
+            await self.relay.close()
         if self._ping_task:
             self._ping_task.cancel()
         for task in list(self._recv_tasks):
@@ -225,9 +248,29 @@ class P2PNode:
         self._spawn_recv(conn)
 
     async def _dial(self, peer: PeerSpec) -> _Conn:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(peer.host, peer.port), SEND_TIMEOUT
-        )
+        """Direct TCP dial, with relay fallback: when the peer is
+        unreachable and a relay is configured, run the SAME mutual
+        handshake + MAC'd framing over a relay virtual stream — the
+        relay is a blind forwarder, never a trusted party (ref:
+        p2p/relay.go circuit-relay-v2; relayed conns stay libp2p-TLS
+        end-to-end)."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(peer.host, peer.port), SEND_TIMEOUT
+            )
+        except (OSError, asyncio.TimeoutError):
+            if self.relay is None:
+                raise
+            reader, writer = await self.relay.stream_to(peer.index)
+        try:
+            return await self._handshake_dialer(reader, writer, peer)
+        except BaseException:
+            # close on ANY failure (incl. timeout/cancel): a half-done
+            # handshake must not leave a stale stream/socket behind
+            writer.close()
+            raise
+
+    async def _handshake_dialer(self, reader, writer, peer: PeerSpec) -> _Conn:
         nonce_s = await asyncio.wait_for(reader.readexactly(16), RECV_TIMEOUT)
         nonce_c = os.urandom(16)
         digest = self._transcript(

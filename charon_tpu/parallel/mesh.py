@@ -136,14 +136,19 @@ class SlotCryptoPlane:
             msg_rep = jax.tree_util.tree_map(
                 lambda a: jnp.repeat(a, t + 1, axis=0), msg
             )
-            # padding lanes carry live=False: zero their random exponent
-            # so their (possibly garbage) pairing value contributes ^0=1.
-            # (point * 0 = identity -> masked to the neutral line.)
-            rand_rep = jnp.repeat(
-                jnp.where(live[:, None], rand, 0), t + 1, axis=0
-            )
+            # INDEPENDENT exponent per verify lane ([Vl, t+1] from the
+            # host): sharing one exponent across a validator's t+1 lanes
+            # would let colluding operators craft partial-sig deltas whose
+            # errors cancel deterministically inside the shared-exponent
+            # product (the group-sig lane error is a public Lagrange
+            # combination of the partial errors). Padding lanes carry
+            # live=False: zero their exponent so their (possibly garbage)
+            # pairing value contributes ^0 = 1.
+            rand_flat = jnp.where(
+                live[:, None, None], rand, 0
+            ).reshape(-1, rand.shape[-1])
             ok = DP.batched_verify_rlc(
-                ctx, fr_ctx, pk_all, msg_rep, sig_all, rand_rep
+                ctx, fr_ctx, pk_all, msg_rep, sig_all, rand_flat
             )
             bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
             return group_sig, bad == 0
@@ -159,28 +164,35 @@ class SlotCryptoPlane:
         return jax.jit(sharded)
 
     def step_rlc(self, pubshares, msg, partials, group_pk, indices, live, rand):
-        """Fast path: (group_sig, all_ok). `rand` is a [V] raw Fr limb
-        array of nonzero 64-bit exponents (host randomness)."""
+        """Fast path: (group_sig, all_ok). `rand` is a [V, t+1] raw Fr
+        limb array of independent nonzero 64-bit exponents (host
+        randomness, one per verify lane — see make_rand)."""
         return self._step_rlc(
             pubshares, msg, partials, group_pk, indices, live, rand
         )
 
     def make_rand(self, v: int, rng=None) -> jnp.ndarray:
-        """[V_padded] nonzero 64-bit exponents packed as raw Fr limbs."""
+        """[V_padded, t+1] independent nonzero 64-bit exponents packed as
+        raw Fr limbs. Defaults to OS randomness (SystemRandom) — the
+        2^-64 soundness bound assumes exponents unpredictable to the
+        signers; pass a seeded Random only in tests."""
         import random as _random
 
-        rng = rng or _random.Random()
+        rng = rng or _random.SystemRandom()
         shards = self.shard_count()
         vp = v + ((-v) % shards)
         return jnp.asarray(
             np.asarray(
                 [
-                    limb.int_to_limbs(
-                        rng.randrange(1, 1 << 64),
-                        self.fr_ctx.n_limbs,
-                        self.fr_ctx.limb_bits,
-                        self.fr_ctx.np_dtype,
-                    )
+                    [
+                        limb.int_to_limbs(
+                            rng.randrange(1, 1 << 64),
+                            self.fr_ctx.n_limbs,
+                            self.fr_ctx.limb_bits,
+                            self.fr_ctx.np_dtype,
+                        )
+                        for _ in range(self.t + 1)
+                    ]
                     for _ in range(vp)
                 ]
             )

@@ -137,9 +137,19 @@ def test_step_rlc_all_valid_and_forged(plane):
 
 
 def test_step_rlc_padding_lanes_ignored(plane):
+    """Padding lanes (live=False) must not affect the verdict even when
+    their content is INVALID — corrupt the padded region explicitly
+    (pack_inputs pads by duplicating lane 0, which would pass vacuously)."""
     v = 5
     pubshares, msgs, partials, group_pks, indices = _workload(v)
-    args = plane.pack_inputs(pubshares, msgs, partials, group_pks, indices)
+    ps, msg, sig, gpk, idx, live = plane.pack_inputs(
+        pubshares, msgs, partials, group_pks, indices
+    )
+    # overwrite a padding lane's partials with another lane's (wrong
+    # message => invalid partials in the dead region)
+    import jax as _jax
+
+    sig = _jax.tree_util.tree_map(lambda a: a.at[6].set(a[2]), sig)
     rand = plane.make_rand(v, rng=random.Random(7))
-    _, all_ok = plane.step_rlc(*args, rand)
+    _, all_ok = plane.step_rlc(ps, msg, sig, gpk, idx, live, rand)
     assert bool(all_ok)

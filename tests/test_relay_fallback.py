@@ -1,0 +1,111 @@
+"""Relay fallback: when a peer's direct address is unreachable, the node
+dials through the relay over a virtual stream and runs the SAME mutual
+handshake + MAC'd framing — the relay stays a blind forwarder
+(ref: p2p/relay.go circuit-relay-v2; relayed conns stay e2e-encrypted)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.app import k1util
+from charon_tpu.p2p.relay import RelayClient, RelayServer
+from charon_tpu.p2p.transport import P2PNode, PeerSpec
+
+
+def _nodes(relay_port, a_port, b_port_advertised, b_port_real):
+    cluster = b"\x07" * 32
+    keys = [k1util.generate_private_key() for _ in range(2)]
+    pubs = [k1util.public_key_to_bytes(k.public_key()) for k in keys]
+    # node 0 advertises node 1 at a WRONG port: direct dials fail
+    specs_for_a = [
+        PeerSpec(index=0, pubkey=pubs[0], host="127.0.0.1", port=a_port),
+        PeerSpec(index=1, pubkey=pubs[1], host="127.0.0.1", port=b_port_advertised),
+    ]
+    specs_for_b = [
+        PeerSpec(index=0, pubkey=pubs[0], host="127.0.0.1", port=a_port),
+        PeerSpec(index=1, pubkey=pubs[1], host="127.0.0.1", port=b_port_real),
+    ]
+    a = P2PNode(
+        0, keys[0], specs_for_a, cluster,
+        relay=RelayClient("127.0.0.1", relay_port, cluster, 0),
+    )
+    b = P2PNode(
+        1, keys[1], specs_for_b, cluster,
+        relay=RelayClient("127.0.0.1", relay_port, cluster, 1),
+    )
+    return a, b
+
+
+def test_relay_fallback_request_response():
+    async def main():
+        relay = RelayServer()
+        relay_port = await relay.start()
+        # node B listens on an ephemeral port but A knows a dead one
+        import socket
+
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()  # nothing listens here
+
+        a, b = _nodes(relay_port, 0, dead_port, 0)
+        # bind real listeners on ephemeral ports
+        a.self_spec = PeerSpec(0, a.self_spec.pubkey, "127.0.0.1", 0)
+        b.self_spec = PeerSpec(1, b.self_spec.pubkey, "127.0.0.1", 0)
+        await a.start()
+        await b.start()
+
+        got = {}
+
+        async def echo(source, msg):
+            got["msg"] = (source, msg)
+            return {"pong": msg["n"] + 1}
+
+        b.register_handler("echo", echo)
+        try:
+            resp = await a.send(1, "echo", {"n": 41}, await_response=True)
+            assert resp == {"pong": 42}
+            # authenticated source index, not attacker-controlled
+            assert got["msg"][0] == 0
+        finally:
+            await a.stop()
+            await b.stop()
+            await relay.stop()
+
+    asyncio.run(main())
+
+
+def test_direct_dial_still_preferred():
+    async def main():
+        relay = RelayServer()
+        relay_port = await relay.start()
+        cluster = b"\x07" * 32
+        keys = [k1util.generate_private_key() for _ in range(2)]
+        pubs = [k1util.public_key_to_bytes(k.public_key()) for k in keys]
+        specs = [
+            PeerSpec(0, pubs[0], "127.0.0.1", 0),
+            PeerSpec(1, pubs[1], "127.0.0.1", 0),
+        ]
+        a = P2PNode(0, keys[0], specs, cluster,
+                    relay=RelayClient("127.0.0.1", relay_port, cluster, 0))
+        b = P2PNode(1, keys[1], specs, cluster,
+                    relay=RelayClient("127.0.0.1", relay_port, cluster, 1))
+        await b.start()
+        # fix up A's view of B's real listening port (ephemeral)
+        real_port = b._server.sockets[0].getsockname()[1]
+        a.peers[1] = PeerSpec(1, pubs[1], "127.0.0.1", real_port)
+        await a.start()
+
+        async def pong(source, msg):
+            return {"ok": True}
+
+        b.register_handler("x", pong)
+        try:
+            resp = await a.send(1, "x", {}, await_response=True)
+            assert resp == {"ok": True}
+        finally:
+            await a.stop()
+            await b.stop()
+            await relay.stop()
+
+    asyncio.run(main())
