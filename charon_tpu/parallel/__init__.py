@@ -12,4 +12,5 @@ all-valid flags.
 from charon_tpu.parallel.mesh import (  # noqa: F401
     SlotCryptoPlane,
     make_mesh,
+    make_mesh_2d,
 )

@@ -35,6 +35,32 @@ def make_mesh(devices=None, axis: str = "shards") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+def make_mesh_2d(
+    n_hosts: int, devices=None, axes: tuple[str, str] = ("dcn", "ici")
+) -> Mesh:
+    """Multi-host mesh layout: leading axis across hosts (DCN), trailing
+    axis across each host's chips (ICI).
+
+    The slot plane's only collective is a scalar psum, which XLA lowers
+    to an intra-host reduce over the minor (ICI) axis first and a single
+    tiny cross-host reduce after — the validator batch axis is sharded
+    over BOTH axes (flattened), so all bulk data stays device-local and
+    nothing bulk ever crosses DCN (scaling-book recipe: shard so
+    collectives ride ICI; DCN carries only scalars here).
+
+    On real multi-host TPU the device list comes from
+    `jax.distributed.initialize()` + `jax.devices()`; in tests the same
+    layout is exercised by reshaping the 8-device virtual CPU mesh to
+    (2 hosts x 4 chips)."""
+    devices = devices if devices is not None else jax.devices()
+    devices = np.asarray(devices)
+    if devices.size % n_hosts:
+        raise ValueError(
+            f"{devices.size} devices do not split over {n_hosts} hosts"
+        )
+    return Mesh(devices.reshape(n_hosts, -1), axes)
+
+
 class SlotCryptoPlane:
     """The per-slot batched crypto program, sharded over a mesh.
 
@@ -57,7 +83,10 @@ class SlotCryptoPlane:
         self.t = t
         self.ctx = ctx or limb.default_fp_ctx()
         self.fr_ctx = fr_ctx or limb.default_fr_ctx()
-        self.axis = mesh.axis_names[0]
+        # all mesh axes shard the validator batch dim together: on a
+        # 2D (dcn, ici) mesh the flattened sharding keeps bulk data
+        # device-local and the scalar psum is the only cross-axis op
+        self.axis = tuple(mesh.axis_names)
         self._step = self._build()
         self._step_rlc = self._build_rlc()
 

@@ -174,3 +174,42 @@ def test_tracking_wire_option():
         assert tr._errors[duty]
 
     asyncio.run(run())
+
+
+def test_forkjoin_bounded_order_and_failures():
+    """ref: app/forkjoin/forkjoin.go — bounded fan-out, input order,
+    per-input failure capture."""
+    import asyncio
+
+    from charon_tpu.app.forkjoin import flatten, forkjoin
+
+    async def main():
+        concurrent, peak = 0, 0
+
+        async def work(x):
+            nonlocal concurrent, peak
+            concurrent += 1
+            peak = max(peak, concurrent)
+            await asyncio.sleep(0.01)
+            concurrent -= 1
+            if x == 5:
+                raise ValueError("boom")
+            return x * 10
+
+        results = await forkjoin(list(range(12)), work, workers=3)
+        assert peak <= 3
+        assert [r.input for r in results] == list(range(12))
+        assert results[5].error is not None and not results[5].ok
+        assert [r.output for r in results if r.ok] == [
+            x * 10 for x in range(12) if x != 5
+        ]
+        try:
+            flatten(results)
+        except ValueError as e:
+            assert str(e) == "boom"
+        else:
+            raise AssertionError("flatten must raise the first failure")
+        ok = await forkjoin([1, 2], work)
+        assert flatten(ok) == [10, 20]
+
+    asyncio.run(main())

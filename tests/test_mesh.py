@@ -153,3 +153,19 @@ def test_step_rlc_padding_lanes_ignored(plane):
     rand = plane.make_rand(v, rng=random.Random(7))
     _, all_ok = plane.step_rlc(ps, msg, sig, gpk, idx, live, rand)
     assert bool(all_ok)
+
+
+def test_2d_mesh_dcn_ici_layout():
+    """Same slot step on a (2 hosts x 4 chips) mesh: validator axis
+    sharded over BOTH axes, scalar psum over both — the multi-host
+    layout (bulk data device-local; only scalars cross the DCN axis)."""
+    from charon_tpu.parallel import make_mesh_2d
+
+    plane = SlotCryptoPlane(make_mesh_2d(2, jax.devices()), t=T)
+    v = 8
+    pubshares, msgs, partials, group_pks, indices = _workload(v)
+    group_sig, ok, total = plane.step_host(
+        pubshares, msgs, partials, group_pks, indices
+    )
+    assert ok == [True] * v
+    assert total == v
