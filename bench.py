@@ -10,13 +10,15 @@ the reference executes one herumi C++ call at a time
 core/parsigex/parsigex.go:94-98 peer-sig verify). Here a whole batch runs
 as one XLA program on the accelerator.
 
-Verification kernel: random-linear-combination batch verification
-(ops/pairing.py batched_verify_rlc) — one Miller pair per signature plus
-one shared pair and ONE shared final exponentiation, with 64-bit random
-exponents supplied per run (2^-64 soundness; on a False the caller
-re-runs the per-lane kernel to attribute, exactly the strategy consensus
-clients use for gossip batches). The workload here is all-valid, so the
-batch must verify True.
+Verification kernel: GROUPED random-linear-combination batch verification
+(ops/pairing.py batched_verify_grouped_rlc) — lanes sharing a message
+collapse into one Miller pair per distinct message (plus one aggregate
+pair) under per-lane 64-bit random exponents, with ONE shared final
+exponentiation (2^-64 soundness; on a False the caller re-runs the
+per-lane kernel to attribute — exactly the strategy consensus clients
+use for gossip batches, and the same message-sharing structure a DV
+cluster sees: every validator in a committee signs the same attestation
+data). The workload here is all-valid, so the batch must verify True.
 
 Budget discipline (round-1 bench timed out, VERDICT Weak #1):
   * the workload is generated on host by the native C++ backend
@@ -61,11 +63,38 @@ def hb(msg: str) -> None:
 
 
 def main() -> None:
+    import threading
+
+    # Watchdog: a wedged device tunnel hangs jax backend init forever
+    # (observed: jax.devices() blocking >1h after a chip-lease hiccup).
+    # The driver must ALWAYS get one parseable line, so if init doesn't
+    # finish in time we print the error JSON and hard-exit.
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(timeout=300):
+            print(
+                json.dumps(
+                    {
+                        "metric": "batched_bls_verify",
+                        "value": 0.0,
+                        "unit": "sigs/sec",
+                        "vs_baseline": 0.0,
+                        "error": "device init watchdog: backend claim hung >300s (tunnel wedged)",
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
 
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     hb(f"jax up, devices={jax.devices()}")
+    init_done.set()
 
     from charon_tpu.crypto import h2c
     from charon_tpu.crypto.g1g2 import g1_from_bytes, g2_from_bytes
@@ -101,19 +130,33 @@ def main() -> None:
     hb(f"host workload built: {nmax} keys/sigs")
 
     def pack(npack):
-        pk = C.g1_pack(ctx, [g1_from_bytes(p) for p in pks[:npack]])
-        msg = C.g2_pack(ctx, [msg_pts[i % n_msgs] for i in range(npack)])
-        sig = C.g2_pack(ctx, [g2_from_bytes(s) for s in sigs[:npack]])
+        """[M, K] grouped layout: lane i signed message i % n_msgs, so
+        group m holds lanes m, m+n_msgs, m+2*n_msgs, ..."""
+        import numpy as np
+
+        m = min(n_msgs, npack)
+        k = npack // m
+        # lane index for group g, slot j is j*n_msgs + g in the original
+        # round-robin assignment (sig[i] covers msgs_raw[i % n_msgs])
+        order = [j * n_msgs + g for g in range(m) for j in range(k)]
+        pk = C.g1_pack(ctx, [g1_from_bytes(pks[i]) for i in order])
+        pk = jax.tree_util.tree_map(lambda a: a.reshape(m, k, -1), pk)
+        sig = C.g2_pack(ctx, [g2_from_bytes(sigs[i]) for i in order])
+        sig = jax.tree_util.tree_map(lambda a: a.reshape(m, k, -1), sig)
+        msg = C.g2_pack(ctx, msg_pts[:m])
         rand = jax.numpy.asarray(
-            limb.ctx_pack(
-                fr_ctx, [rng.randrange(1, 1 << 64) for _ in range(npack)]
-            )
+            np.asarray(
+                limb.ctx_pack(
+                    fr_ctx,
+                    [rng.randrange(1, 1 << 64) for _ in range(m * k)],
+                )
+            ).reshape(m, k, -1)
         )
         return pk, msg, sig, rand
 
     def make_kernel():
         return jax.jit(
-            lambda pk, msg, sig, r: DP.batched_verify_rlc(
+            lambda pk, msg, sig, r: DP.batched_verify_grouped_rlc(
                 ctx, fr_ctx, pk, msg, sig, r
             )
         )
@@ -152,9 +195,13 @@ def main() -> None:
     batch, packed = None, None
     for attempt in BATCHES:
         try:
+            # actual verified lane count: pack() lays lanes out [M, K]
+            # with K = attempt // n_msgs, so a non-multiple batch would
+            # otherwise silently verify fewer sigs than reported
+            actual = min(n_msgs, attempt) * (attempt // min(n_msgs, attempt))
             packed = pack(attempt)
-            run_verify(packed, f"main batch={attempt}")
-            batch = attempt
+            run_verify(packed, f"main batch={actual}")
+            batch = actual
             break
         except AssertionError:
             raise  # verification failing is a correctness bug, not a size issue

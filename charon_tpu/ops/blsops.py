@@ -112,6 +112,18 @@ def _verify_kernel(ctx: ModCtx):
 
 
 @functools.lru_cache(maxsize=None)
+def _verify_rlc_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    return jax.jit(functools.partial(DP.batched_verify_rlc, ctx, fr_ctx))
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_grouped_rlc_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    return jax.jit(
+        functools.partial(DP.batched_verify_grouped_rlc, ctx, fr_ctx)
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def _aggregate_kernel(ctx: ModCtx, k: int):
     """Sum k G2 points per lane (signature aggregation)."""
     f = C.g2_ops(ctx)
@@ -213,6 +225,83 @@ class BlsEngine:
         sig = C.g2_pack(self.ctx, list(sigs) + [None] * (pad - n))
         ok = _verify_kernel(self.ctx)(pk, msg, sig)
         return [bool(b) for b in np.asarray(ok)[:n]]
+
+    def verify_batch_rlc(self, pks, msg_points, sigs, rng=None) -> bool:
+        """Whole-batch verification by random linear combination (see
+        ops/pairing.batched_verify_rlc): one shared final exponentiation,
+        2^-64 soundness per call with fresh OS randomness. None lanes
+        (identity points) contribute neutrally — the caller tracks their
+        validity separately. Returns a single bool; on False the caller
+        re-runs verify_batch for per-lane attribution."""
+        import random as _random
+
+        rng = rng or _random.SystemRandom()
+        n = len(pks)
+        pad = _next_pow2(n)
+        pk = C.g1_pack(self.ctx, list(pks) + [None] * (pad - n))
+        msg = C.g2_pack(self.ctx, list(msg_points) + [None] * (pad - n))
+        sig = C.g2_pack(self.ctx, list(sigs) + [None] * (pad - n))
+        rand = jnp.asarray(
+            limb.ctx_pack(
+                self.fr_ctx,
+                [rng.randrange(1, 1 << 64) for _ in range(n)]
+                + [0] * (pad - n),
+            )
+        )
+        ok = _verify_rlc_kernel(self.ctx, self.fr_ctx)(pk, msg, sig, rand)
+        return bool(ok)
+
+    def verify_batch_grouped_rlc(self, groups, rng=None) -> bool:
+        """Grouped whole-batch verification
+        (ops/pairing.batched_verify_grouped_rlc): `groups` is a list of
+        (msg_point, [(pk_point, sig_point), ...]) — one entry per
+        DISTINCT message. The Miller stage runs one pair per group plus
+        one aggregate pair; per-lane cost is two 64-bit scalar muls.
+        Grid dims are padded to powers of two so compiled kernels are
+        reused across calls (pad lanes: identity points + zero
+        exponents, which contribute neutrally). Returns a single bool."""
+        import random as _random
+
+        rng = rng or _random.SystemRandom()
+        m = _next_pow2(len(groups))
+        k = _next_pow2(max(len(lanes) for _, lanes in groups))
+        pk_flat: list = []
+        sig_flat: list = []
+        rand_ints: list = []
+        msg_list: list = []
+        for msg_pt, lanes in groups:
+            msg_list.append(msg_pt)
+            for pk_pt, sig_pt in lanes:
+                pk_flat.append(pk_pt)
+                sig_flat.append(sig_pt)
+                rand_ints.append(rng.randrange(1, 1 << 64))
+            pad = k - len(lanes)
+            pk_flat.extend([None] * pad)
+            sig_flat.extend([None] * pad)
+            rand_ints.extend([0] * pad)
+        for _ in range(m - len(groups)):  # identity pad groups
+            msg_list.append(None)
+            pk_flat.extend([None] * k)
+            sig_flat.extend([None] * k)
+            rand_ints.extend([0] * k)
+
+        def grid(packed):
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape(m, k, *a.shape[1:]), packed
+            )
+
+        pk = grid(C.g1_pack(self.ctx, pk_flat))
+        sig = grid(C.g2_pack(self.ctx, sig_flat))
+        msg = C.g2_pack(self.ctx, msg_list)
+        rand = jnp.asarray(
+            np.asarray(limb.ctx_pack(self.fr_ctx, rand_ints)).reshape(
+                m, k, -1
+            )
+        )
+        ok = _verify_grouped_rlc_kernel(self.ctx, self.fr_ctx)(
+            pk, msg, sig, rand
+        )
+        return bool(ok)
 
     # -- threshold recombination -----------------------------------------
 

@@ -394,6 +394,116 @@ def _fp12_prod_tree(ctx: ModCtx, f):
     return jax.tree_util.tree_map(lambda x: x[0], f)
 
 
+def _pad_pow2(C, f, pts, axis: int, n: int):
+    """Pad a (possibly batched) point axis to the next power of two with
+    identity points that inherit the source's shard_map varying axes."""
+    pow2 = 1 << (n - 1).bit_length()
+    if pow2 == n:
+        return pts, n
+    lead = jax.tree_util.tree_leaves(pts)[0].shape[:axis]
+    ident = C.point_identity(f, (*lead, pow2 - n))
+
+    def vary(o, ref):
+        slicer = [slice(None)] * axis + [slice(0, 1)]
+        return o + ref[tuple(slicer)] * jnp.zeros((), ref.dtype)
+
+    ident = jax.tree_util.tree_map(vary, ident, pts)
+    pts = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate((a, b), axis=axis), pts, ident
+    )
+    return pts, pow2
+
+
+def _point_sum_tree(C, f, pts, n: int, axis: int = 0):
+    """Log-depth pairwise sum of projective points over `axis` (any
+    static size — padded to a power of two with identities; complete
+    adds are identity-safe)."""
+    pts, n = _pad_pow2(C, f, pts, axis, n)
+    sl = lambda x, a, b: x[
+        tuple([slice(None)] * axis + [slice(a, b)])
+    ]
+    while n > 1:
+        half = n // 2
+        a = jax.tree_util.tree_map(lambda x: sl(x, 0, half), pts)
+        b = jax.tree_util.tree_map(lambda x: sl(x, half, None), pts)
+        pts = C.point_add(f, a, b)
+        n = half
+    return jax.tree_util.tree_map(
+        lambda x: x[tuple([slice(None)] * axis + [0])], pts
+    )
+
+
+def batched_verify_grouped_rlc(
+    ctx: ModCtx, fr_ctx: ModCtx, pk, msg, sig, rand, nbits: int = 64
+):
+    """Grouped random-linear-combination batch verification:
+
+        prod_m e( sum_{i in m} r_i * pk_i,  H(m) )  *  e(-G1, sum_i r_i * sig_i) == 1
+
+    Layout: lanes pre-grouped by message on host — pk/sig/rand have shape
+    [M, K] (M distinct messages, K lanes per group, padded with identity
+    points + ZERO exponents), msg has shape [M].
+
+    Per lane the pairing work collapses to one 64-bit G1 double-and-add
+    and one 64-bit G2 double-and-add; the Miller stage runs over only
+    M + 1 pairs and ONE final exponentiation — at production scale
+    (thousands of partial signatures over a handful of duty roots per
+    slot: every validator in a committee signs the same attestation
+    data) this is ~10x fewer field ops per signature than the per-lane
+    kernel, and the compiled program's Miller batch no longer grows with
+    the signature count. Same 2^-nbits Schwartz-Zippel soundness as
+    batched_verify_rlc (per-lane independent exponents bind each pk/sig
+    pair); the construction consensus clients use for gossip batches.
+
+    Returns a scalar bool (all-valid).
+    """
+    from charon_tpu.ops import curve as C
+
+    g1f, g2f = C.g1_ops(ctx), C.g2_ops(ctx)
+    m_groups, k = pk[0].shape[0], pk[0].shape[1]
+
+    def flat2(t):
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape(m_groups * k, *a.shape[2:]), t
+        )
+
+    rand_flat = rand.reshape(m_groups * k, -1)
+
+    # [M*K] 64-bit scalar muls on both sides (zero exponents -> identity)
+    pk_r = C.point_scalar_mul(
+        g1f, fr_ctx, C.affine_to_point(g1f, flat2(pk)), rand_flat, nbits=nbits
+    )
+    sig_r = C.point_scalar_mul(
+        g2f, fr_ctx, C.affine_to_point(g2f, flat2(sig)), rand_flat, nbits=nbits
+    )
+
+    # per-group sums over the K axis -> [M], then the G2 total over M
+    def regroup(t, f):
+        t = jax.tree_util.tree_map(
+            lambda a: a.reshape(m_groups, k, *a.shape[1:]), t
+        )
+        return _point_sum_tree(C, f, t, k, axis=1)
+
+    buckets = regroup(pk_r, g1f)  # [M] G1 projective
+    sig_groups = regroup(sig_r, g2f)  # [M] G2 projective
+    s_total = _point_sum_tree(C, g2f, sig_groups, m_groups)
+
+    bucket_aff = C.point_to_affine(g1f, buckets)
+    s_aff = C.point_to_affine(g2f, s_total)
+
+    # Miller lanes: M bucket pairs ++ 1 aggregate pair, then one final exp
+    def append_lane(a, b):
+        return jnp.concatenate((a, b[None, ...]), axis=0)
+
+    neg_g = neg_g1_gen(ctx, ())
+    pk_lanes = jax.tree_util.tree_map(append_lane, bucket_aff, neg_g)
+    q_lanes = jax.tree_util.tree_map(append_lane, msg, s_aff)
+    f_lanes = miller_loop(ctx, [(pk_lanes, q_lanes)])  # [M+1] fp12
+    f_tot = _fp12_prod_tree(ctx, f_lanes)
+    e = final_exp(ctx, f_tot)
+    return T.fp12_is_one(ctx, e)
+
+
 def batched_verify_rlc(
     ctx: ModCtx, fr_ctx: ModCtx, pk, msg, sig, rand, nbits: int = 64
 ):

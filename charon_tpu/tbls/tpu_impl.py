@@ -104,6 +104,11 @@ class TPUImpl(Implementation):
         if not self.verify_batch([(pubkey, data, sig)])[0]:
             raise TblsError("signature verification failed")
 
+    # Below this size the per-lane kernel is used directly: RLC's shared
+    # tail amortizes only over larger batches, and small shapes would
+    # compile a second kernel family for no win.
+    RLC_MIN_BATCH = 16
+
     def verify_batch(self, items) -> list[bool]:
         if not items:
             return []
@@ -122,12 +127,45 @@ class TPUImpl(Implementation):
             except TblsError:
                 ok[i] = False
                 pks[i] = msgs[i] = sigs[i] = None
-        verified = self.engine.verify_batch(pks, msgs, sigs)
+        if n >= self.RLC_MIN_BATCH and self._rlc_accepts(items, pks, msgs, sigs):
+            # the whole batch verified in one shared-final-exp program;
+            # decode failures (ok[i] False) pass None lanes which
+            # contribute neutrally and stay False below
+            verified = [True] * n
+        else:
+            verified = self.engine.verify_batch(pks, msgs, sigs)
         if self.verify_inputs:
             in_subgroup = self.engine.subgroup_check_g2_batch(sigs)
         else:
             in_subgroup = [True] * n
         return [o and v and s for o, v, s in zip(ok, verified, in_subgroup)]
+
+    # At most this many distinct messages take the grouped kernel (one
+    # Miller pair per message); beyond it, the ungrouped RLC kernel.
+    RLC_MAX_GROUPS = 8
+
+    def _rlc_accepts(self, items, pks, msgs, sigs) -> bool:
+        """Whole-batch RLC check, grouped by message when few distinct
+        messages exist (a DV cluster's common case: every validator in a
+        committee signs the same attestation data, so a slot's partial
+        sigs collapse to a handful of Miller pairs)."""
+        distinct: dict[bytes, list[int]] = {}
+        for i, (_, data, _) in enumerate(items):
+            distinct.setdefault(data, []).append(i)
+        if len(distinct) <= self.RLC_MAX_GROUPS:
+            groups = []
+            for data, lane_ids in distinct.items():
+                lanes = [
+                    (pks[i], sigs[i])
+                    for i in lane_ids
+                    if pks[i] is not None
+                ]
+                if lanes:
+                    groups.append((_cached_msg_point(data), lanes))
+            if not groups:
+                return True  # nothing decodable; per-lane flags carry it
+            return self.engine.verify_batch_grouped_rlc(groups)
+        return self.engine.verify_batch_rlc(pks, msgs, sigs)
 
     def verify_aggregate(self, pubkeys: Sequence[bytes], data: bytes, sig: bytes) -> None:
         if not pubkeys:

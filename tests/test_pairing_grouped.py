@@ -1,0 +1,89 @@
+"""Grouped RLC batch verification (ops/pairing.batched_verify_grouped_rlc):
+one Miller pair per distinct message + one aggregate pair, one final exp.
+Cross-checked against per-lane verification semantics."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from charon_tpu.crypto import bls, h2c
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import limb
+from charon_tpu.ops import pairing as DP
+
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = __import__("pytest").mark.slow
+
+M, K = 2, 3  # K=3 exercises the pad-to-pow2 path inside each group
+
+
+def _workload(forge=None, wrong_group=None):
+    """[M, K] lanes: group m all sign message m."""
+    ctx = limb.default_fp_ctx()
+    msgs_raw = [b"group-msg-%d" % m for m in range(M)]
+    msg_pts = [h2c.hash_to_g2(x) for x in msgs_raw]
+    pks, sigs = [], []
+    for m in range(M):
+        for j in range(K):
+            sk = bls.keygen(bytes([m * K + j + 1]) * 32)
+            pks.append(bls.sk_to_pk(sk))
+            signed = msgs_raw[m]
+            if forge == (m, j):
+                signed = b"forged"
+            if wrong_group == (m, j):
+                signed = msgs_raw[(m + 1) % M]
+            sigs.append(bls.sign(sk, signed))
+    pk = C.g1_pack(ctx, pks)
+    pk = jax.tree_util.tree_map(lambda a: a.reshape(M, K, -1), pk)
+    sig = C.g2_pack(ctx, sigs)
+    sig = jax.tree_util.tree_map(lambda a: a.reshape(M, K, -1), sig)
+    msg = C.g2_pack(ctx, msg_pts)
+    return ctx, pk, msg, sig
+
+
+def _rand(fr_ctx, seed=11):
+    rng = random.Random(seed)
+    flat = limb.ctx_pack(
+        fr_ctx, [rng.randrange(1, 1 << 64) for _ in range(M * K)]
+    )
+    return jnp.asarray(np.asarray(flat).reshape(M, K, -1))
+
+
+@pytest.fixture(scope="module")
+def kernel():
+    fp, fr = limb.default_fp_ctx(), limb.default_fr_ctx()
+    return jax.jit(
+        lambda pk, msg, sig, r: DP.batched_verify_grouped_rlc(
+            fp, fr, pk, msg, sig, r
+        )
+    )
+
+
+def test_grouped_accepts_valid(kernel):
+    ctx, pk, msg, sig = _workload()
+    assert bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
+
+
+def test_grouped_rejects_forged_lane(kernel):
+    ctx, pk, msg, sig = _workload(forge=(1, 2))
+    assert not bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
+
+
+def test_grouped_rejects_wrong_group_signature(kernel):
+    """A signature valid for ANOTHER group's message must not pass in its
+    own group (the bucket binds lanes to their group's message)."""
+    ctx, pk, msg, sig = _workload(wrong_group=(0, 1))
+    assert not bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
+
+
+def test_grouped_zero_exponent_lanes_neutral(kernel):
+    """Zero exponents (padding) neutralize a lane even if its content is
+    garbage — swap in a forged sig AND zero that lane's exponent."""
+    ctx, pk, msg, sig = _workload(forge=(0, 0))
+    rand = np.array(_rand(limb.default_fr_ctx()), copy=True)
+    rand[0, 0] = 0
+    assert bool(kernel(pk, msg, sig, jnp.asarray(rand)))

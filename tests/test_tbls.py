@@ -165,3 +165,43 @@ def test_randomized_impl_full_suite(impls, cluster):
         tbls.verify(pk, b"hello", s)
     finally:
         tbls.set_implementation(impls[0])
+
+
+def test_tpu_verify_batch_rlc_path():
+    """Batches >= RLC_MIN_BATCH take the shared-final-exp fast path; a
+    forged lane falls back to the per-lane kernel and is attributed."""
+    impl = TPUImpl()
+    n = TPUImpl.RLC_MIN_BATCH
+    items = []
+    for i in range(n):
+        sk = impl.generate_secret_key()
+        pk = impl.secret_to_public_key(sk)
+        items.append((pk, b"rlc-batch-%d" % i, impl.sign(sk, b"rlc-batch-%d" % i)))
+    assert impl.verify_batch(items) == [True] * n
+    # forge lane 9: same message signed by the WRONG key
+    sk = impl.generate_secret_key()
+    items[9] = (items[9][0], b"rlc-batch-9", impl.sign(sk, b"rlc-batch-9"))
+    got = impl.verify_batch(items)
+    assert got[9] is False
+    assert [g for i, g in enumerate(got) if i != 9] == [True] * (n - 1)
+
+
+def test_tpu_verify_batch_grouped_path():
+    """Few distinct messages (the cluster-slot shape): the grouped RLC
+    kernel verifies the batch; a wrong-key lane still gets attributed by
+    the per-lane fallback."""
+    impl = TPUImpl()
+    n = TPUImpl.RLC_MIN_BATCH
+    msgs = [b"grouped-a", b"grouped-b"]
+    items = []
+    for i in range(n):
+        sk = impl.generate_secret_key()
+        pk = impl.secret_to_public_key(sk)
+        data = msgs[i % 2]
+        items.append((pk, data, impl.sign(sk, data)))
+    assert impl.verify_batch(items) == [True] * n
+    sk = impl.generate_secret_key()
+    items[5] = (items[5][0], items[5][1], impl.sign(sk, items[5][1]))
+    got = impl.verify_batch(items)
+    assert got[5] is False
+    assert [g for i, g in enumerate(got) if i != 5] == [True] * (n - 1)
