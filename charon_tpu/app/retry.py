@@ -16,6 +16,22 @@ from typing import Awaitable, Callable
 BACKOFF_SECS = 1.0  # ref: retry/retry.go constant backoff
 
 
+def _retryable() -> tuple:
+    # lazy: avoid a hard import edge at module load; AllClientsFailedError
+    # (every configured BN failed) is the framework's own transient
+    # network failure and MUST be retried (ref: retry.go classifies
+    # net/url errors as temporary)
+    from charon_tpu.app.eth2wrap import AllClientsFailedError
+
+    return (
+        ConnectionError,
+        TimeoutError,
+        asyncio.TimeoutError,
+        OSError,
+        AllClientsFailedError,
+    )
+
+
 RETRYABLE = (ConnectionError, TimeoutError, asyncio.TimeoutError, OSError)
 
 
@@ -36,7 +52,7 @@ class Retryer:
             try:
                 await fn(duty, *args)
                 return
-            except RETRYABLE:
+            except _retryable():
                 if self.now() + self.backoff >= deadline:
                     return  # deadline exceeded; tracker reports the miss
                 await asyncio.sleep(self.backoff)

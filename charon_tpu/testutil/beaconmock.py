@@ -238,7 +238,9 @@ class BeaconMock:
 
         def fuzz_attestation_data(slot: int, committee_index: int):
             if rng.random() < error_rate:
-                raise RuntimeError("beaconmock fuzz: synthetic BN error")
+                # ConnectionError: the honest simulation of a BN outage —
+                # the workflow's retryer classifies it transient
+                raise ConnectionError("beaconmock fuzz: synthetic BN error")
             epoch = slot // self.slots_per_epoch
             return AttestationData(
                 slot=rng.randrange(max(1, slot * 2) + 1),

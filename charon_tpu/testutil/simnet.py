@@ -168,18 +168,19 @@ def _build_node(
         slots_per_epoch=spe,
     )
 
-    # fetcher.fetch must run as its own task: proposer fetches block on the
-    # aggregated randao, which only exists after the VC submits its randao
-    # partial — the reference decouples this with async retry
-    # (ref: app/retry wired via core.WithAsyncRetry, app/app.go:571).
-    def spawn_fetch(name, fn):
-        if name != "fetcher.fetch":
-            return fn
+    # fetcher.fetch runs as its own deadline-bounded retried task, same
+    # as production (ref: app/retry wired via core.WithAsyncRetry,
+    # app/app.go:571): the proposer fetch blocks on the aggregated
+    # randao, and transient BN failures (fuzzed or real) re-fetch until
+    # the duty deadline.
+    from charon_tpu.app.retry import Retryer, with_async_retry
 
-        async def wrapped(duty, defs):
-            asyncio.create_task(fn(duty, defs))
-
-        return wrapped
+    clock = beacon.clock()
+    retryer = Retryer(
+        deadline_of=clock.duty_deadline,
+        backoff=max(0.05, beacon.slot_duration / 8),
+    )
+    spawn_fetch = with_async_retry(retryer)
 
     wire(
         scheduler=scheduler,

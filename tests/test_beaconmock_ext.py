@@ -38,7 +38,7 @@ def test_fuzz_randomizes_attestation_data_and_injects_errors():
         for _ in range(20):
             try:
                 datas.append(await mock.attestation_data(3, 0))
-            except RuntimeError:
+            except ConnectionError:
                 errors += 1
         assert errors > 0, "fuzz must inject synthetic BN errors"
         assert datas, "fuzz must still return shape-valid data sometimes"

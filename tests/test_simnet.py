@@ -89,3 +89,44 @@ def test_simnet_attestation_flow_qbft():
         await _drive_and_check(cluster)
 
     asyncio.run(run())
+
+
+def test_simnet_survives_fuzzed_beacon():
+    """Nightly-fuzz analogue (ref: testutil/compose/fuzz +
+    beaconmock_fuzz.go): the beacon mock returns randomized shape-valid
+    attestation data and injects synthetic errors, and the cluster must
+    keep completing duties — consensus agrees on whatever the leader
+    fetched, partials verify, broadcasts land."""
+
+    async def run():
+        cluster = build_cluster(
+            n=4, t=3, num_validators=1, slot_duration=0.4, use_qbft=True
+        )
+        cluster.beacon.enable_fuzz(seed=3, error_rate=0.3)
+        tasks = [
+            asyncio.create_task(node.scheduler.run())
+            for node in cluster.nodes
+        ]
+        beacon = cluster.beacon
+        try:
+
+            async def some_attestations():
+                while len(beacon.attestations) < 4:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(some_attestations(), timeout=60)
+        finally:
+            for node in cluster.nodes:
+                node.scheduler.stop()
+            for task in tasks:
+                task.cancel()
+        # every broadcast attestation carries a valid group signature
+        # over the fuzzed (but agreed) data
+        att = beacon.attestations[0]
+        root = SignedData("attestation", att).signing_root(
+            cluster.fork, att.data.slot // beacon.slots_per_epoch
+        )
+        group_pk = cluster.group_pubkeys[0]
+        tbls.verify(pubkey_to_bytes(group_pk), root, att.signature)
+
+    asyncio.run(run())
