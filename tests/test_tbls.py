@@ -167,41 +167,91 @@ def test_randomized_impl_full_suite(impls, cluster):
         tbls.set_implementation(impls[0])
 
 
+# The two RLC-path tests run in FRESH subprocesses: this image's jaxlib
+# flakily segfaults (de)serializing large CPU executables to the
+# persistent cache once a process has accumulated many compiled programs
+# (see CI.md "Known environment flake") — process isolation sidesteps it.
+# pins the CPU platform + shared cache exactly like conftest (the child
+# process does not import conftest, and the image's sitecustomize would
+# otherwise claim the TPU tunnel)
+_ISOLATED_HEADER = """
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+"""
+
+_RLC_PATH_SCRIPT = _ISOLATED_HEADER + """
+from charon_tpu.tbls.tpu_impl import TPUImpl
+
+impl = TPUImpl()
+n = TPUImpl.RLC_MIN_BATCH
+items = []
+for i in range(n):
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    items.append((pk, b"rlc-batch-%d" % i, impl.sign(sk, b"rlc-batch-%d" % i)))
+assert impl.verify_batch(items) == [True] * n
+# forge lane 9: same message signed by the WRONG key
+sk = impl.generate_secret_key()
+items[9] = (items[9][0], b"rlc-batch-9", impl.sign(sk, b"rlc-batch-9"))
+got = impl.verify_batch(items)
+assert got[9] is False
+assert [g for i, g in enumerate(got) if i != 9] == [True] * (n - 1)
+print("RLC-PATH-OK")
+"""
+
+_GROUPED_PATH_SCRIPT = _ISOLATED_HEADER + """
+from charon_tpu.tbls.tpu_impl import TPUImpl
+
+impl = TPUImpl()
+n = TPUImpl.RLC_MIN_BATCH
+msgs = [b"grouped-a", b"grouped-b"]
+items = []
+for i in range(n):
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    data = msgs[i % 2]
+    items.append((pk, data, impl.sign(sk, data)))
+assert impl.verify_batch(items) == [True] * n
+sk = impl.generate_secret_key()
+items[5] = (items[5][0], items[5][1], impl.sign(sk, items[5][1]))
+got = impl.verify_batch(items)
+assert got[5] is False
+assert [g for i, g in enumerate(got) if i != 5] == [True] * (n - 1)
+print("GROUPED-PATH-OK")
+"""
+
+
+def _run_isolated(script: str, marker: str) -> None:
+    import os
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=1500,
+        env={**os.environ, "PYTHONPATH": os.getcwd()},
+        cwd=os.getcwd(),
+    )
+    assert proc.returncode == 0, (
+        f"isolated RLC test failed rc={proc.returncode}:\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert marker in proc.stdout
+
+
 def test_tpu_verify_batch_rlc_path():
     """Batches >= RLC_MIN_BATCH take the shared-final-exp fast path; a
     forged lane falls back to the per-lane kernel and is attributed."""
-    impl = TPUImpl()
-    n = TPUImpl.RLC_MIN_BATCH
-    items = []
-    for i in range(n):
-        sk = impl.generate_secret_key()
-        pk = impl.secret_to_public_key(sk)
-        items.append((pk, b"rlc-batch-%d" % i, impl.sign(sk, b"rlc-batch-%d" % i)))
-    assert impl.verify_batch(items) == [True] * n
-    # forge lane 9: same message signed by the WRONG key
-    sk = impl.generate_secret_key()
-    items[9] = (items[9][0], b"rlc-batch-9", impl.sign(sk, b"rlc-batch-9"))
-    got = impl.verify_batch(items)
-    assert got[9] is False
-    assert [g for i, g in enumerate(got) if i != 9] == [True] * (n - 1)
+    _run_isolated(_RLC_PATH_SCRIPT, "RLC-PATH-OK")
 
 
 def test_tpu_verify_batch_grouped_path():
     """Few distinct messages (the cluster-slot shape): the grouped RLC
     kernel verifies the batch; a wrong-key lane still gets attributed by
     the per-lane fallback."""
-    impl = TPUImpl()
-    n = TPUImpl.RLC_MIN_BATCH
-    msgs = [b"grouped-a", b"grouped-b"]
-    items = []
-    for i in range(n):
-        sk = impl.generate_secret_key()
-        pk = impl.secret_to_public_key(sk)
-        data = msgs[i % 2]
-        items.append((pk, data, impl.sign(sk, data)))
-    assert impl.verify_batch(items) == [True] * n
-    sk = impl.generate_secret_key()
-    items[5] = (items[5][0], items[5][1], impl.sign(sk, items[5][1]))
-    got = impl.verify_batch(items)
-    assert got[5] is False
-    assert [g for i, g in enumerate(got) if i != 5] == [True] * (n - 1)
+    _run_isolated(_GROUPED_PATH_SCRIPT, "GROUPED-PATH-OK")
