@@ -193,6 +193,7 @@ class ExpBackoff:
         self.max_delay = max_delay
         self.jitter = jitter
         self._attempt = 0
+        self._waited = False
 
     def next_delay(self) -> float:
         import random
@@ -202,13 +203,16 @@ class ExpBackoff:
         return random.uniform(0, delay) if self.jitter else delay
 
     async def wait(self) -> None:
-        if self._attempt:
+        # first call returns immediately WITHOUT consuming an attempt, so
+        # the first real sleep is the base delay (not base*factor)
+        if self._waited:
             await asyncio.sleep(self.next_delay())
         else:
-            self._attempt = 1
+            self._waited = True
 
     def reset(self) -> None:
         self._attempt = 0
+        self._waited = False
 
 
 SYNTH_GRAFFITI = b"charon-tpu-synthetic"

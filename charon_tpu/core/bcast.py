@@ -116,29 +116,34 @@ class Broadcaster:
         logs and carries on)."""
         if slot.slot % slot.slots_per_epoch != 0:
             return
-        try:
-            for duty, data_set in list(self._registrations.items()):
-                for pubkey, signed in data_set.items():
-                    await self.beacon.submit_registration(
-                        signed.payload, signed.signature
-                    )
-            # pre-generated registrations from the lock: skip any pubkey
-            # the VC has submitted a fresher registration for
-            submitted = {
-                getattr(signed.payload, "pubkey", None)
-                for ds in self._registrations.values()
-                for signed in ds.values()
-            }
-            for reg, sig in getattr(self, "_pregen", []):
-                if reg.pubkey in submitted:
-                    continue
-                await self.beacon.submit_registration(reg, sig)
-        except Exception as e:  # noqa: BLE001 — log-and-continue
-            from charon_tpu.app import log
+        from charon_tpu.app import log
 
-            log.warn(
-                "registration recast failed",
-                topic="bcast",
-                slot=slot.slot,
-                err=str(e),
-            )
+        async def _submit_one(pubkey, payload, signature) -> None:
+            # per-registration isolation: one persistently rejected
+            # registration (e.g. a 400 on one pubkey) must not starve
+            # every other validator's recast
+            try:
+                await self.beacon.submit_registration(payload, signature)
+            except Exception as e:  # noqa: BLE001 — log-and-continue
+                log.warn(
+                    "registration recast failed",
+                    topic="bcast",
+                    slot=slot.slot,
+                    pubkey=str(pubkey)[:18],
+                    err=str(e),
+                )
+
+        for duty, data_set in list(self._registrations.items()):
+            for pubkey, signed in data_set.items():
+                await _submit_one(pubkey, signed.payload, signed.signature)
+        # pre-generated registrations from the lock: skip any pubkey
+        # the VC has submitted a fresher registration for
+        submitted = {
+            getattr(signed.payload, "pubkey", None)
+            for ds in self._registrations.values()
+            for signed in ds.values()
+        }
+        for reg, sig in getattr(self, "_pregen", []):
+            if reg.pubkey in submitted:
+                continue
+            await _submit_one(reg.pubkey, reg, sig)

@@ -162,22 +162,13 @@ class ContributionAndProof:
         return ssz.hash_tree_root(self)
 
 
-@dataclass(frozen=True)
-class ValidatorRegistration:
-    fee_recipient: bytes  # 20
-    gas_limit: int
-    timestamp: int
-    pubkey: bytes  # 48
-
-    ssz_fields: ClassVar = (
-        ssz.ByteVector(20),
-        ssz.UINT64,
-        ssz.UINT64,
-        ssz.BYTES48,
-    )
-
-    def hash_tree_root(self) -> bytes:
-        return ssz.hash_tree_root(self)
+# Canonical builder-spec ValidatorRegistrationV1 lives in
+# eth2util/registration.py (single SSZ schema — two definitions of the
+# same consensus container can silently drift); re-exported here for the
+# core workflow's convenience.
+from charon_tpu.eth2util.registration import (  # noqa: E402
+    ValidatorRegistration,
+)
 
 
 @dataclass(frozen=True)

@@ -97,20 +97,33 @@ class _VirtualWriter:
             data, self._buf = bytes(self._buf), bytearray()
             await self._client.send(self._to, b"VS" + data, stream=True)
 
-    def close(self) -> None:
-        # always detach from the demux so the next stream_to/inbound VO
-        # starts FRESH — a stale half-dead pair must never be reused
+    def _detach(self) -> bool:
+        """Detach from the demux so the next stream_to/inbound VO starts
+        FRESH — a stale half-dead pair must never be reused. Returns
+        whether the VC close frame still needs sending."""
         if self._client._streams.get(self._to, (None, None))[1] is self:
             self._client._streams.pop(self._to, None)
             self._client._stream_origin.pop(self._to, None)
-        if not self._closing:
-            self._closing = True
+        if self._closing:
+            return False
+        self._closing = True
+        return True
+
+    def close(self) -> None:
+        if self._detach():
             try:
                 asyncio.get_running_loop().create_task(
                     self._client.send(self._to, b"VC", stream=True)
                 )
             except RuntimeError:
                 pass  # no running loop (teardown)
+
+    async def aclose(self) -> None:
+        """Inline (awaited) close: the VC frame is on the wire before the
+        caller's next send, so a peer can never observe a newer open
+        before this close."""
+        if self._detach():
+            await self._client.send(self._to, b"VC", stream=True)
 
     def is_closing(self) -> bool:
         return self._closing
@@ -192,8 +205,20 @@ class RelayClient:
                 raise ConnectionError(
                     f"relay stream to {peer_idx} busy (inbound in progress)"
                 )
-            # stale dialer-side pair: drop it and start fresh
-            pair[1].close()
+            # stale dialer-side pair: drop it and start fresh. The close
+            # frame is awaited so the peer can never observe the new VO
+            # before the stale VC (close() defers its VC via create_task,
+            # which could land after our VO and kill the fresh stream).
+            await pair[1].aclose()
+            # the await may have let a new pair appear — an inbound VO
+            # from _recv_loop OR a concurrent stream_to that registered a
+            # fresh dialer pair. Either way that stream has an owner;
+            # joining it would interleave two handshakes, so refuse and
+            # let the caller's retry find the established connection.
+            if peer_idx in self._streams:
+                raise ConnectionError(
+                    f"relay stream to {peer_idx} busy (concurrent open)"
+                )
         pair = self._stream_pair(peer_idx, "out")
         await self.send(peer_idx, b"VO", stream=True)
         return pair
@@ -212,6 +237,13 @@ class RelayClient:
                     # payloads can never be hijacked by tag collisions
                     if payload[:2] in (b"VO", b"VS"):
                         existed = frm in self._streams
+                        if payload[:2] == b"VS" and not existed:
+                            # only VO opens a stream: a VS addressed to no
+                            # registered stream is a stale flush from a
+                            # torn-down pair — spawning a phantom inbound
+                            # stream from it would block re-dials until
+                            # its garbage handshake times out
+                            continue
                         reader, _writer = self._stream_pair(frm, "in")
                         if payload[2:]:
                             reader.feed_data(payload[2:])

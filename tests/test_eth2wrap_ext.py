@@ -161,3 +161,28 @@ def test_expbackoff_growth_and_reset():
     assert [b.next_delay() for _ in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
     b.reset()
     assert b.next_delay() == 1.0
+
+
+def test_expbackoff_first_sleep_is_base_delay():
+    """wait() #1 returns immediately; wait() #2 sleeps the BASE delay —
+    the free first call must not consume attempt 0."""
+    b = ExpBackoff(base=1.0, factor=2.0, max_delay=8.0, jitter=False)
+    slept = []
+
+    async def main():
+        real_sleep = asyncio.sleep
+
+        async def spy(d):
+            slept.append(d)
+            await real_sleep(0)
+
+        asyncio.sleep = spy
+        try:
+            await b.wait()  # free
+            await b.wait()  # base
+            await b.wait()  # base*factor
+        finally:
+            asyncio.sleep = real_sleep
+
+    asyncio.run(main())
+    assert slept == [1.0, 2.0]

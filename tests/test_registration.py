@@ -161,3 +161,44 @@ def test_recaster_broadcasts_pregen_registrations():
     Slot.slot = 9
     asyncio.run(bcast.recast(Slot()))
     assert len(beacon.registrations) == 1
+
+
+def test_recaster_one_rejection_does_not_starve_rest():
+    """A persistently rejected registration (e.g. a 400 on one pubkey)
+    must not abort the remaining re-broadcasts for that epoch — failure
+    isolation is per registration, matching the reference recaster's
+    log-and-continue loop."""
+    from charon_tpu.core.bcast import Broadcaster
+    from charon_tpu.testutil.beaconmock import BeaconMock
+
+    fork = ForkInfo(bytes(32), b"\x00" * 4, b"\x00" * 4)
+    dvs, pks = [], []
+    for i in range(3):
+        sk = tbls.generate_secret_key()
+        pk = tbls.secret_to_public_key(sk)
+        reg = _reg(pubkey=pk)
+        sig = tbls.sign(sk, regmod.signing_root(reg, fork))
+        dv = type("DV", (), {"builder_registration": regmod.to_lock_json(reg, sig)})()
+        dvs.append(dv)
+        pks.append(pk)
+
+    beacon = BeaconMock(slots_per_epoch=4)
+    reject = {pks[0]}
+    orig = beacon.submit_registration
+
+    async def flaky(reg, sig):
+        if reg.pubkey in reject:
+            raise RuntimeError("400 bad registration")
+        return await orig(reg, sig)
+
+    beacon.submit_registration = flaky
+    bcast = Broadcaster(beacon=beacon)
+    assert bcast.load_pregen_registrations(dvs) == 3
+
+    class Slot:
+        slot = 4
+        slots_per_epoch = 4
+
+    asyncio.run(bcast.recast(Slot()))
+    # the first pubkey failed, the other two still went out
+    assert sorted(r.pubkey for r, _ in beacon.registrations) == sorted(pks[1:])
