@@ -19,10 +19,12 @@ import aiohttp
 
 from charon_tpu.core.eth2data import (
     AttestationData,
-    BeaconBlockHeader,
     Checkpoint,
     Proposal,
+    proposal_from_data_json,
+    signed_proposal_json,
 )
+from charon_tpu.eth2util import spec as spec_mod
 
 
 class HttpError(RuntimeError):
@@ -71,10 +73,10 @@ class Eth2HttpClient:
             await self.close()  # force a fresh connection next call
             raise
 
-    async def _post(self, path: str, body: Any) -> Any:
+    async def _post(self, path: str, body: Any, headers=None) -> Any:
         try:
             async with self._sess().post(
-                self.base_url + path, json=body
+                self.base_url + path, json=body, headers=headers
             ) as resp:
                 if resp.status not in (200, 202):
                     raise HttpError(
@@ -190,37 +192,22 @@ class Eth2HttpClient:
     async def block_proposal(
         self, slot: int, proposer_index: int, randao: bytes
     ) -> Proposal:
-        """The framework signs header roots (Proposal.hash_tree_root ==
-        header root). A real node's v3 response carries the full block
-        body but NOT its body_root; computing it requires full
-        BeaconBlockBody SSZ, which this client does not implement yet —
-        signing a zeroed body_root would produce a slashable wrong
-        signature, so refuse unless the response includes body_root
-        (some DV-aware middlewares do)."""
-        d = (
-            await self._get(
-                f"/eth/v3/validator/blocks/{slot}",
-                randao_reveal="0x" + randao.hex(),
-            )
-        )["data"]
-        block = d.get("block") or d.get("blinded_block") or d
-        if "body_root" not in block:
-            raise NotImplementedError(
-                "beacon response lacks body_root; full-block SSZ "
-                "hashing is required for proposals against this node"
-            )
-        import json as _json
-
-        return Proposal(
-            header=BeaconBlockHeader(
-                slot=slot,
-                proposer_index=proposer_index,
-                parent_root=_hx(block.get("parent_root", "0x" + "00" * 32)),
-                state_root=_hx(block.get("state_root", "0x" + "00" * 32)),
-                body_root=_hx(block["body_root"]),
-            ),
-            body=_json.dumps(block).encode(),
+        """produceBlockV3: parse the full fork-versioned block container
+        (or its blinded variant) from the response; the block root the
+        cluster signs is computed with spec SSZ from the complete body
+        (eth2util/spec.py), exactly as any consensus client would
+        (ref: core/fetcher/fetcher.go fetchProposerData +
+        eth2wrap Proposal)."""
+        j = await self._get(
+            f"/eth/v3/validator/blocks/{slot}",
+            randao_reveal="0x" + randao.hex(),
         )
+        version = j.get("version", spec_mod.latest_fork())
+        blinded = str(j.get("execution_payload_blinded", False)).lower() in (
+            "true",
+            "1",
+        )
+        return proposal_from_data_json(version, blinded, j["data"])
 
     # -- aggregation / sync-committee surfaces ----------------------------
 
@@ -333,18 +320,19 @@ class Eth2HttpClient:
         )
 
     async def submit_proposal(self, proposal, signature: bytes) -> None:
-        """Posts the FULL block (stored as JSON in Proposal.body by
-        block_proposal) with the group signature — the SignedBeaconBlock
-        wire shape a real node requires."""
-        import json as _json
-
-        if proposal.body:
-            message = _json.loads(proposal.body.decode())
-        else:
-            message = _header_json(proposal.header)
+        """publishBlock / publishBlindedBlock (v2, with the
+        Eth-Consensus-Version header): the exact SignedBeaconBlock (or
+        deneb signed block contents) wire shape a production node
+        requires."""
+        path = (
+            "/eth/v2/beacon/blinded_blocks"
+            if proposal.blinded
+            else "/eth/v2/beacon/blocks"
+        )
         await self._post(
-            "/eth/v2/beacon/blocks",
-            {"message": message, "signature": "0x" + signature.hex()},
+            path,
+            signed_proposal_json(proposal, signature),
+            headers={"Eth-Consensus-Version": proposal.version},
         )
 
     async def submit_aggregate(self, agg_and_proof, signature: bytes) -> None:
@@ -501,11 +489,3 @@ def _bits_hex_vector(bits) -> str:
     return "0x" + bytes(raw).hex()
 
 
-def _header_json(h) -> dict:
-    return {
-        "slot": str(h.slot),
-        "proposer_index": str(h.proposer_index),
-        "parent_root": "0x" + h.parent_root.hex(),
-        "state_root": "0x" + h.state_root.hex(),
-        "body_root": "0x" + h.body_root.hex(),
-    }

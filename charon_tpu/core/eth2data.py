@@ -21,88 +21,135 @@ from charon_tpu.eth2util import ssz
 from charon_tpu.eth2util.signing import DomainName, ForkInfo
 
 # ---------------------------------------------------------------------------
-# Spec containers (subset needed by the duty workflow)
+# Spec containers — canonical definitions live in eth2util/spec.py (single
+# SSZ schema per consensus container); re-exported here for the workflow.
 # ---------------------------------------------------------------------------
 
-
-@dataclass(frozen=True)
-class Checkpoint:
-    epoch: int
-    root: bytes  # 32
-
-    ssz_fields: ClassVar = (ssz.UINT64, ssz.BYTES32)
-
-
-@dataclass(frozen=True)
-class AttestationData:
-    slot: int
-    index: int
-    beacon_block_root: bytes
-    source: Checkpoint
-    target: Checkpoint
-
-    ssz_fields: ClassVar = (
-        ssz.UINT64,
-        ssz.UINT64,
-        ssz.BYTES32,
-        ssz.Nested(),
-        ssz.Nested(),
-    )
-
-    def hash_tree_root(self) -> bytes:
-        return ssz.hash_tree_root(self)
-
-
-@dataclass(frozen=True)
-class Attestation:
-    aggregation_bits: tuple[bool, ...]
-    data: AttestationData
-    signature: bytes = bytes(96)
-
-    ssz_fields: ClassVar = (
-        ssz.Bitlist(2048),
-        ssz.Nested(),
-        ssz.BYTES96,
-    )
-
-    def hash_tree_root(self) -> bytes:
-        return ssz.hash_tree_root(self)
-
-
-@dataclass(frozen=True)
-class BeaconBlockHeader:
-    slot: int
-    proposer_index: int
-    parent_root: bytes
-    state_root: bytes
-    body_root: bytes
-
-    ssz_fields: ClassVar = (
-        ssz.UINT64,
-        ssz.UINT64,
-        ssz.BYTES32,
-        ssz.BYTES32,
-        ssz.BYTES32,
-    )
-
-    def hash_tree_root(self) -> bytes:
-        return ssz.hash_tree_root(self)
+from charon_tpu.eth2util.spec import (  # noqa: E402,F401
+    Attestation,
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    VoluntaryExit,
+)
+from charon_tpu.eth2util import spec as _spec  # noqa: E402
 
 
 @dataclass(frozen=True)
 class Proposal:
-    """A block proposal: the spec header (whose root is signed) plus the
-    opaque full/blinded body payload the beacon node gave us, round-tripped
-    back on submission (the reference carries whole VersionedProposal
-    objects, ref: core/unsigneddata.go VersionedProposal; the workflow only
-    ever needs the root and the bytes)."""
+    """A fork-versioned block proposal: the FULL spec block container
+    (or its blinded builder variant), exactly as the beacon node returned
+    it and exactly as it is re-submitted once group-signed. The signed
+    root is the block root, which by SSZ construction equals the
+    header-with-body-root root (ref: core/unsigneddata.go
+    VersionedProposal carries the same per-fork go-eth2-client block
+    union; router.go:151-175 routes on the version discriminator).
 
-    header: BeaconBlockHeader
-    body: bytes = b""
+    Deneb-onward full proposals also carry the sidecar blobs + KZG proofs
+    through consensus so the winning node can publish complete block
+    contents (they do not enter the signing root)."""
+
+    version: str  # fork name: "capella" | "deneb"
+    block: object  # eth2util/spec per-fork (Blinded)BeaconBlock container
     blinded: bool = False
+    kzg_proofs: tuple = ()
+    blobs: tuple = ()
+
+    @property
+    def slot(self) -> int:
+        return self.block.slot
+
+    @property
+    def proposer_index(self) -> int:
+        return self.block.proposer_index
+
+    def header(self) -> BeaconBlockHeader:
+        return self.block.header()
 
     def hash_tree_root(self) -> bytes:
-        return self.header.hash_tree_root()
+        return self.block.hash_tree_root()
+
+
+# Forks whose FULL proposals travel as block *contents* (block + blobs +
+# proofs) on the produce/publish endpoints rather than a bare block.
+FORKS_WITH_CONTENTS = frozenset({"deneb"})
+
+_hex0x = _spec.hex0x
+_unhex0x = _spec.unhex0x
+
+
+def sniff_block_version(block_json: dict) -> str:
+    """Fork of a bare block JSON object when no Eth-Consensus-Version
+    header accompanied it: the body's field set discriminates."""
+    body = block_json.get("body", {})
+    return "deneb" if "blob_kzg_commitments" in body else "capella"
+
+
+def proposal_data_json(p: Proposal) -> dict:
+    """The produceBlockV3 `data` payload: bare (blinded) block JSON, or
+    deneb-style block contents for full post-deneb proposals
+    (ref: router.go:151 produceBlockV3 response shapes)."""
+    bj = _spec.to_json(p.block)
+    if p.blinded or p.version not in FORKS_WITH_CONTENTS:
+        return bj
+    return {
+        "block": bj,
+        "kzg_proofs": [_hex0x(x) for x in p.kzg_proofs],
+        "blobs": [_hex0x(x) for x in p.blobs],
+    }
+
+
+def proposal_from_data_json(version: str, blinded: bool, data: dict) -> Proposal:
+    cls = _spec.block_class(version, blinded)
+    if blinded or version not in FORKS_WITH_CONTENTS:
+        return Proposal(version, _spec.from_json(cls, data), blinded)
+    return Proposal(
+        version,
+        _spec.from_json(cls, data["block"]),
+        blinded,
+        kzg_proofs=tuple(_unhex0x(x) for x in data.get("kzg_proofs", ())),
+        blobs=tuple(_unhex0x(x) for x in data.get("blobs", ())),
+    )
+
+
+def signed_proposal_json(p: Proposal, signature: bytes) -> dict:
+    """The publishBlock / publishBlindedBlock POST body: a
+    SignedBeaconBlock (message+signature), wrapped as signed block
+    contents for full post-deneb proposals (ref: router.go:157-175
+    submitProposal / submitBlindedBlock)."""
+    signed = {
+        "message": _spec.to_json(p.block),
+        "signature": _hex0x(signature),
+    }
+    if p.blinded or p.version not in FORKS_WITH_CONTENTS:
+        return signed
+    return {
+        "signed_block": signed,
+        "kzg_proofs": [_hex0x(x) for x in p.kzg_proofs],
+        "blobs": [_hex0x(x) for x in p.blobs],
+    }
+
+
+def signed_proposal_from_json(
+    j: dict, blinded: bool, version: str | None = None
+) -> tuple[Proposal, bytes]:
+    """Parse a publish POST body. `version` comes from the
+    Eth-Consensus-Version header when the VC sent one; otherwise the
+    block JSON is sniffed."""
+    if "signed_block" in j:  # deneb block contents
+        inner = j["signed_block"]
+        kzg = tuple(_unhex0x(x) for x in j.get("kzg_proofs", ()))
+        blobs = tuple(_unhex0x(x) for x in j.get("blobs", ()))
+    else:
+        inner = j
+        kzg, blobs = (), ()
+    msg = inner["message"]
+    ver = version or sniff_block_version(msg)
+    block = _spec.from_json(_spec.block_class(ver, blinded), msg)
+    return (
+        Proposal(ver, block, blinded, kzg_proofs=kzg, blobs=blobs),
+        _unhex0x(inner["signature"]),
+    )
 
 
 @dataclass(frozen=True)
@@ -169,17 +216,6 @@ class ContributionAndProof:
 from charon_tpu.eth2util.registration import (  # noqa: E402
     ValidatorRegistration,
 )
-
-
-@dataclass(frozen=True)
-class VoluntaryExit:
-    epoch: int
-    validator_index: int
-
-    ssz_fields: ClassVar = (ssz.UINT64, ssz.UINT64)
-
-    def hash_tree_root(self) -> bytes:
-        return ssz.hash_tree_root(self)
 
 
 # ---------------------------------------------------------------------------

@@ -130,8 +130,8 @@ class ValidatorAPI:
 
     async def submit_proposal(self, pubkey: PubKey, proposal: Proposal, signature: bytes) -> None:
         signed = SignedData("block", proposal, signature)
-        self._check_batch([self._verify_item(pubkey, signed, proposal.header.slot)])
-        duty = Duty(proposal.header.slot, DutyType.PROPOSER)
+        self._check_batch([self._verify_item(pubkey, signed, proposal.slot)])
+        duty = Duty(proposal.slot, DutyType.PROPOSER)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
 

@@ -34,7 +34,6 @@ from charon_tpu.core.eth2data import (
     AggregateAndProof,
     Attestation,
     AttestationData,
-    BeaconBlockHeader,
     Checkpoint,
     ContributionAndProof,
     Proposal,
@@ -42,9 +41,12 @@ from charon_tpu.core.eth2data import (
     SyncCommitteeMessage,
     ValidatorRegistration,
     VoluntaryExit,
+    proposal_data_json,
+    signed_proposal_from_json,
 )
 from charon_tpu.core.types import Duty, DutyType, PubKey
 from charon_tpu.core.validatorapi import ValidatorAPI, VapiError
+from charon_tpu.eth2util import spec
 
 # ---------------------------------------------------------------------------
 # JSON codecs (eth2 beacon API shapes)
@@ -59,117 +61,42 @@ def _unhex(s: str) -> bytes:
     return bytes.fromhex(s[2:] if s.startswith("0x") else s)
 
 
+# One SSZ-bitfield/attestation JSON codec exists: the descriptor-driven
+# one in eth2util/spec.py. The wrappers below keep the local call sites
+# and legacy signatures (proposal shapes live in core/eth2data.py).
+
+
 def _att_data_json(d: AttestationData) -> dict:
-    return {
-        "slot": str(d.slot),
-        "index": str(d.index),
-        "beacon_block_root": _hex(d.beacon_block_root),
-        "source": {"epoch": str(d.source.epoch), "root": _hex(d.source.root)},
-        "target": {"epoch": str(d.target.epoch), "root": _hex(d.target.root)},
-    }
+    return spec.to_json(d)
 
 
 def _att_data_from_json(j: dict) -> AttestationData:
-    return AttestationData(
-        slot=int(j["slot"]),
-        index=int(j["index"]),
-        beacon_block_root=_unhex(j["beacon_block_root"]),
-        source=Checkpoint(int(j["source"]["epoch"]), _unhex(j["source"]["root"])),
-        target=Checkpoint(int(j["target"]["epoch"]), _unhex(j["target"]["root"])),
-    )
+    return spec.from_json(AttestationData, j)
 
 
 def _bits_from_hex(hexstr: str) -> tuple[bool, ...]:
-    """Eth2 SSZ bitlist hex -> bool tuple (delimiter bit trimmed)."""
-    raw = _unhex(hexstr)
-    bits = []
-    for byte in raw:
-        for i in range(8):
-            bits.append(bool(byte >> i & 1))
-    while bits and not bits[-1]:
-        bits.pop()
-    if bits:
-        bits.pop()  # remove delimiter
-    return tuple(bits)
+    return spec.bits_from_bytes(_unhex(hexstr), sentinel=True)
 
 
 def _bits_to_hex(bits: tuple[bool, ...]) -> str:
-    all_bits = list(bits) + [True]  # delimiter
-    data = bytearray((len(all_bits) + 7) // 8)
-    for i, b in enumerate(all_bits):
-        if b:
-            data[i // 8] |= 1 << (i % 8)
-    return "0x" + bytes(data).hex()
+    return "0x" + spec.bits_to_bytes(bits, sentinel=True).hex()
 
 
 def _bitvector_to_hex(bits: tuple[bool, ...], size: int = 128) -> str:
-    full = list(bits) + [False] * (size - len(bits))
-    data = bytearray(size // 8)
-    for i, b in enumerate(full[:size]):
-        if b:
-            data[i // 8] |= 1 << (i % 8)
-    return "0x" + bytes(data).hex()
+    full = tuple(bits) + (False,) * (size - len(bits))
+    return "0x" + spec.bits_to_bytes(full[:size], sentinel=False).hex()
 
 
 def _bitvector_from_hex(hexstr: str, size: int = 128) -> tuple[bool, ...]:
-    raw = _unhex(hexstr)
-    bits = []
-    for byte in raw:
-        for i in range(8):
-            bits.append(bool(byte >> i & 1))
-    return tuple(bits[:size])
+    return spec.bits_from_bytes(_unhex(hexstr), sentinel=False, length=size)
 
 
 def _attestation_json(a: Attestation) -> dict:
-    return {
-        "aggregation_bits": _bits_to_hex(a.aggregation_bits),
-        "data": _att_data_json(a.data),
-        "signature": _hex(a.signature),
-    }
+    return spec.to_json(a)
 
 
 def _attestation_from_json(j: dict) -> Attestation:
-    return Attestation(
-        aggregation_bits=_bits_from_hex(j["aggregation_bits"]),
-        data=_att_data_from_json(j["data"]),
-        signature=_unhex(j["signature"]),
-    )
-
-
-def _header_json(h: BeaconBlockHeader) -> dict:
-    return {
-        "slot": str(h.slot),
-        "proposer_index": str(h.proposer_index),
-        "parent_root": _hex(h.parent_root),
-        "state_root": _hex(h.state_root),
-        "body_root": _hex(h.body_root),
-    }
-
-
-def _header_from_json(j: dict) -> BeaconBlockHeader:
-    return BeaconBlockHeader(
-        slot=int(j["slot"]),
-        proposer_index=int(j["proposer_index"]),
-        parent_root=_unhex(j["parent_root"]),
-        state_root=_unhex(j["state_root"]),
-        body_root=_unhex(j["body_root"]),
-    )
-
-
-def _proposal_json(p: Proposal) -> dict:
-    return {
-        "header": _header_json(p.header),
-        "body": _hex(p.body),
-        "blinded": p.blinded,
-    }
-
-
-def _proposal_from_json(j: dict) -> Proposal:
-    return Proposal(
-        header=_header_from_json(j["header"]),
-        body=_unhex(j["body"]),
-        blinded=bool(j.get("blinded", False)),
-    )
+    return spec.from_json(Attestation, j)
 
 
 def _contribution_json(c: SyncCommitteeContribution) -> dict:
@@ -455,41 +382,76 @@ class VapiRouter:
         )
         if not defs:
             return _err(404, f"no proposer duty at slot {slot}")
-        pubkey = next(iter(defs))
+        # Key by PUBKEY, not an arbitrary duty entry: the randao reveal is
+        # a partial signature by exactly one validator's share, so the
+        # candidate whose pubshare verifies it identifies the proposer —
+        # correct even when two cluster validators propose in the same
+        # slot (ref: router.go maps proposals by pubkey).
+        pubkey, last_err = None, None
+        for candidate in defs:
+            try:
+                await self.vapi.submit_randao(slot, candidate, randao)
+                pubkey = candidate
+                break
+            except VapiError as e:
+                last_err = e
+        if pubkey is None:
+            return _err(400, f"randao reveal matches no proposer: {last_err}")
         try:
-            await self.vapi.submit_randao(slot, pubkey, randao)
             proposal = await self.vapi.proposal(slot, pubkey)
         except VapiError as e:
             return _err(400, str(e))
         return web.json_response(
             {
-                "version": "deneb",
+                "version": proposal.version,
                 "execution_payload_blinded": proposal.blinded,
                 "execution_payload_value": "0",
                 "consensus_block_value": "0",
-                "data": _proposal_json(proposal),
-            }
+                "data": proposal_data_json(proposal),
+            },
+            headers={"Eth-Consensus-Version": proposal.version},
         )
 
     async def _submit_block(self, request: web.Request) -> web.Response:
-        """ref: router.go:157-175 + validatorapi.go:490 SubmitProposal."""
+        """Accepts the spec publishBlock/publishBlindedBlock POST body:
+        a SignedBeaconBlock {message, signature} (or deneb signed block
+        contents {signed_block, kzg_proofs, blobs}), with the fork taken
+        from the Eth-Consensus-Version header when present
+        (ref: router.go:157-175 + validatorapi.go:490 SubmitProposal)."""
+        blinded = "blinded_blocks" in request.path
+        version = request.headers.get("Eth-Consensus-Version")
         try:
             j = await request.json()
-            data = j["data"] if isinstance(j, dict) and "data" in j else j
-            proposal = _proposal_from_json(data["message"])
-            signature = _unhex(data["signature"])
+            proposal, signature = signed_proposal_from_json(
+                j, blinded, version
+            )
         except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             return _err(400, f"malformed block: {e}")
-        defs = (
-            self.vapi._duty_defs(
-                Duty(proposal.header.slot, DutyType.PROPOSER)
+        # key by PUBKEY via the block's proposer index (ref: router.go
+        # submitProposal resolves the proposal by pubkey, never "the
+        # first duty at this slot")
+        pubkey = self._pubkey_by_index.get(proposal.proposer_index)
+        if pubkey is None:
+            # router built without a validators mapping: resolve through
+            # the slot's proposer duty definitions instead
+            defs = (
+                self.vapi._duty_defs(Duty(proposal.slot, DutyType.PROPOSER))
+                if self.vapi._duty_defs
+                else {}
             )
-            if self.vapi._duty_defs
-            else {}
-        )
-        if not defs:
-            return _err(404, f"no proposer duty at slot {proposal.header.slot}")
-        pubkey = next(iter(defs))
+            for pk, dd in defs.items():
+                if getattr(dd, "validator_index", None) == proposal.proposer_index:
+                    pubkey = pk
+                    break
+            else:
+                if len(defs) == 1:
+                    (pubkey,) = defs
+        if pubkey is None:
+            return _err(
+                404,
+                f"unknown proposer index {proposal.proposer_index} "
+                f"at slot {proposal.slot}",
+            )
         try:
             await self.vapi.submit_proposal(pubkey, proposal, signature)
         except VapiError as e:

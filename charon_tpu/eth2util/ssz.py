@@ -74,6 +74,14 @@ class Uint64(SSZType):
 
 
 @dataclass(frozen=True)
+class Uint256(SSZType):
+    """uint256 basic type (execution-layer base_fee_per_gas)."""
+
+    def hash_tree_root(self, value: int) -> bytes:
+        return int(value).to_bytes(32, "little")
+
+
+@dataclass(frozen=True)
 class Boolean(SSZType):
     def hash_tree_root(self, value: bool) -> bytes:
         return bytes([1 if value else 0]) + bytes(31)
@@ -164,7 +172,12 @@ class Bitvector(SSZType):
 
 @dataclass(frozen=True)
 class Nested(SSZType):
-    """Field whose value is itself an ssz_fields-bearing dataclass."""
+    """Field whose value is itself an ssz_fields-bearing dataclass.
+    `cls` (optional) names the concrete container class — required by the
+    generic JSON codec (eth2util/spec.py) to decode; rooting alone never
+    needs it."""
+
+    cls: type | None = None
 
     def hash_tree_root(self, value) -> bytes:
         return hash_tree_root(value)

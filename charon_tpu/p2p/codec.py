@@ -111,6 +111,37 @@ def _register_core_types() -> None:
     from charon_tpu.core import eth2data as d
     from charon_tpu.core import qbft
     from charon_tpu.core.types import Duty, DutyType
+    from charon_tpu.eth2util import spec
+
+    # fork-versioned spec containers ride inside Proposal values during
+    # proposer consensus (ref: corepb carries the full VersionedProposal)
+    for cls in (
+        spec.Eth1Data,
+        spec.SignedBeaconBlockHeader,
+        spec.ProposerSlashing,
+        spec.IndexedAttestation,
+        spec.AttesterSlashing,
+        spec.DepositData,
+        spec.Deposit,
+        spec.SignedVoluntaryExit,
+        spec.SyncAggregate,
+        spec.BLSToExecutionChange,
+        spec.SignedBLSToExecutionChange,
+        spec.Withdrawal,
+        spec.ExecutionPayloadCapella,
+        spec.ExecutionPayloadDeneb,
+        spec.ExecutionPayloadHeaderCapella,
+        spec.ExecutionPayloadHeaderDeneb,
+        spec.BeaconBlockBodyCapella,
+        spec.BlindedBeaconBlockBodyCapella,
+        spec.BeaconBlockBodyDeneb,
+        spec.BlindedBeaconBlockBodyDeneb,
+        spec.BeaconBlockCapella,
+        spec.BlindedBeaconBlockCapella,
+        spec.BeaconBlockDeneb,
+        spec.BlindedBeaconBlockDeneb,
+    ):
+        register(cls)
 
     for cls in (
         Duty,

@@ -17,11 +17,11 @@ from dataclasses import dataclass, field
 from charon_tpu.core.deadline import SlotClock
 from charon_tpu.core.eth2data import (
     AttestationData,
-    BeaconBlockHeader,
     Checkpoint,
     Proposal,
 )
 from charon_tpu.core.types import PubKey
+from charon_tpu.eth2util import spec
 
 
 @dataclass
@@ -136,17 +136,46 @@ class BeaconMock:
         return data
 
     async def block_proposal(self, slot: int, proposer_index: int, randao: bytes) -> Proposal:
-        body = b"mock-body:" + randao[:8]
-        return Proposal(
-            header=BeaconBlockHeader(
-                slot=slot,
-                proposer_index=proposer_index,
-                parent_root=self._root("block", slot - 1),
-                state_root=self._root("state", slot, randao.hex()),
-                body_root=hashlib.sha256(body).digest(),
-            ),
-            body=body,
+        """A spec-complete deneb block: the full BeaconBlockBody container
+        with a real (if minimal) execution payload, so the proposer flow
+        exercises exactly the JSON/SSZ shapes a production beacon node
+        serves (ref: testutil/beaconmock serves go-eth2-client spec
+        blocks for the same reason)."""
+        payload = spec.ExecutionPayloadDeneb(
+            parent_hash=self._root("elblock", slot - 1),
+            fee_recipient=b"\xfe" * 20,
+            state_root=self._root("elstate", slot),
+            receipts_root=self._root("elrcpt", slot),
+            logs_bloom=bytes(256),
+            prev_randao=hashlib.sha256(randao).digest(),
+            block_number=slot,
+            gas_limit=30_000_000,
+            gas_used=21_000,
+            timestamp=int(self.genesis_time) + slot,
+            extra_data=b"beaconmock",
+            base_fee_per_gas=7,
+            block_hash=self._root("elblock", slot),
+            transactions=(b"\x02" + self._root("tx", slot),),
+            withdrawals=(),
         )
+        block = spec.BeaconBlockDeneb(
+            slot=slot,
+            proposer_index=proposer_index,
+            parent_root=self._root("block", slot - 1),
+            state_root=self._root("state", slot, randao.hex()),
+            body=spec.BeaconBlockBodyDeneb(
+                randao_reveal=randao[:96].ljust(96, b"\x00"),
+                eth1_data=spec.Eth1Data(
+                    self._root("dep", slot), slot, self._root("eth1", slot)
+                ),
+                graffiti=b"beaconmock".ljust(32, b"\x00"),
+                sync_aggregate=spec.SyncAggregate(
+                    tuple([False] * 512), bytes(96)
+                ),
+                execution_payload=payload,
+            ),
+        )
+        return Proposal(version="deneb", block=block)
 
     async def aggregate_attestation(self, slot: int, att_data_root: bytes):
         """Aggregate attestation for an att data root (the BN would merge
@@ -189,7 +218,7 @@ class BeaconMock:
         root if one was broadcast for this slot, else the mock chain's
         deterministic root."""
         for proposal, _sig in self.proposals:
-            if proposal.header.slot == slot:
+            if proposal.slot == slot:
                 return proposal.hash_tree_root()
         return self._root("block", slot)
 

@@ -13,6 +13,10 @@ import aiohttp
 
 from charon_tpu.core.types import PubKey
 from charon_tpu.core.validatorapi import VapiError
+from charon_tpu.core.eth2data import (
+    proposal_from_data_json,
+    signed_proposal_json,
+)
 from charon_tpu.core.vapi_http import (
     _att_data_from_json,
     _att_data_json,
@@ -21,10 +25,7 @@ from charon_tpu.core.vapi_http import (
     _bits_to_hex,
     _contribution_from_json,
     _contribution_json,
-    _header_json,
     _hex,
-    _proposal_from_json,
-    _proposal_json,
     _unhex,
 )
 
@@ -53,9 +54,11 @@ class HttpVapiClient:
                 raise VapiError(f"GET {path}: {resp.status} {await resp.text()}")
             return await resp.json()
 
-    async def _post(self, path: str, payload) -> dict | None:
+    async def _post(self, path: str, payload, headers=None) -> dict | None:
         s = await self._sess()
-        async with s.post(self.base + path, json=payload) as resp:
+        async with s.post(
+            self.base + path, json=payload, headers=headers
+        ) as resp:
             if resp.status >= 400:
                 raise VapiError(f"POST {path}: {resp.status} {await resp.text()}")
             if resp.content_type == "application/json":
@@ -84,15 +87,22 @@ class HttpVapiClient:
             f"/eth/v3/validator/blocks/{slot}",
             params={"randao_reveal": _hex(randao_reveal)},
         )
-        return _proposal_from_json(j["data"])
+        blinded = str(j.get("execution_payload_blinded", False)).lower() in (
+            "true",
+            "1",
+        )
+        return proposal_from_data_json(j["version"], blinded, j["data"])
 
     async def submit_block(self, proposal, signature: bytes) -> None:
+        path = (
+            "/eth/v2/beacon/blinded_blocks"
+            if proposal.blinded
+            else "/eth/v2/beacon/blocks"
+        )
         await self._post(
-            "/eth/v2/beacon/blocks",
-            {
-                "message": _proposal_json(proposal),
-                "signature": _hex(signature),
-            },
+            path,
+            signed_proposal_json(proposal, signature),
+            headers={"Eth-Consensus-Version": proposal.version},
         )
 
     # -- aggregator --------------------------------------------------------

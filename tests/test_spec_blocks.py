@@ -1,0 +1,289 @@
+"""Fork-versioned spec block containers + beacon-API JSON shapes
+(eth2util/spec.py, core/eth2data.py proposal codecs, vapi proposer
+keyed-by-pubkey routing). Ref parity: core/validatorapi/router.go:151-175
+produceBlockV3/submitProposal, core/unsigneddata.go VersionedProposal."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core.eth2data import (
+    FORKS_WITH_CONTENTS,
+    Proposal,
+    proposal_data_json,
+    proposal_from_data_json,
+    signed_proposal_from_json,
+    signed_proposal_json,
+    sniff_block_version,
+)
+from charon_tpu.core.types import pubkey_from_bytes
+from charon_tpu.core.validatorapi import VapiError
+from charon_tpu.eth2util import spec, ssz
+
+
+def _mk_block(cls):
+    body_cls = cls.__dataclass_fields__["body"].type
+    return cls(
+        slot=9,
+        proposer_index=4,
+        parent_root=b"\x01" * 32,
+        state_root=b"\x02" * 32,
+        body=body_cls(randao_reveal=b"\x03" * 96),
+    )
+
+
+def _rich_deneb_block():
+    """A deneb block with every body list populated, so the JSON codec's
+    list/nested/bitlist paths all execute."""
+    att_data = spec.AttestationData(
+        slot=9,
+        index=1,
+        beacon_block_root=b"\x0a" * 32,
+        source=spec.Checkpoint(0, b"\x0b" * 32),
+        target=spec.Checkpoint(1, b"\x0c" * 32),
+    )
+    payload = spec.ExecutionPayloadDeneb(
+        parent_hash=b"\x10" * 32,
+        fee_recipient=b"\x11" * 20,
+        state_root=b"\x12" * 32,
+        receipts_root=b"\x13" * 32,
+        logs_bloom=b"\x00" * 256,
+        prev_randao=b"\x14" * 32,
+        block_number=123,
+        gas_limit=30_000_000,
+        gas_used=21_000,
+        timestamp=1_700_000_000,
+        extra_data=b"spec-test",
+        base_fee_per_gas=2**130 + 7,  # exercises uint256 > 64 bits
+        block_hash=b"\x15" * 32,
+        transactions=(b"\x02\xf8\x71", b"\x01\x02"),
+        withdrawals=(spec.Withdrawal(5, 77, b"\x16" * 20, 10_000),),
+        blob_gas_used=131072,
+        excess_blob_gas=0,
+    )
+    body = spec.BeaconBlockBodyDeneb(
+        randao_reveal=b"\x03" * 96,
+        eth1_data=spec.Eth1Data(b"\x04" * 32, 55, b"\x05" * 32),
+        graffiti=b"charon-tpu".ljust(32, b"\x00"),
+        attestations=(
+            spec.Attestation((True, False, True), att_data, b"\x06" * 96),
+        ),
+        voluntary_exits=(
+            spec.SignedVoluntaryExit(spec.VoluntaryExit(2, 9), b"\x07" * 96),
+        ),
+        sync_aggregate=spec.SyncAggregate(
+            tuple(i % 3 == 0 for i in range(512)), b"\x08" * 96
+        ),
+        execution_payload=payload,
+        bls_to_execution_changes=(
+            spec.SignedBLSToExecutionChange(
+                spec.BLSToExecutionChange(3, b"\x09" * 48, b"\x0d" * 20),
+                b"\x0e" * 96,
+            ),
+        ),
+        blob_kzg_commitments=(b"\x0f" * 48,),
+    )
+    return spec.BeaconBlockDeneb(
+        slot=9,
+        proposer_index=4,
+        parent_root=b"\x01" * 32,
+        state_root=b"\x02" * 32,
+        body=body,
+    )
+
+
+def test_all_forks_json_roundtrip():
+    for version in spec.FORK_BLOCKS:
+        for blinded in (False, True):
+            cls = spec.block_class(version, blinded)
+            blk = _mk_block(cls)
+            assert spec.from_json(cls, spec.to_json(blk)) == blk
+
+
+def test_rich_deneb_roundtrip_and_spec_field_names():
+    blk = _rich_deneb_block()
+    j = spec.to_json(blk)
+    assert spec.from_json(spec.BeaconBlockDeneb, j) == blk
+    # exact beacon-API field set on the body (spec deneb BeaconBlockBody)
+    assert list(j["body"].keys()) == [
+        "randao_reveal",
+        "eth1_data",
+        "graffiti",
+        "proposer_slashings",
+        "attester_slashings",
+        "attestations",
+        "deposits",
+        "voluntary_exits",
+        "sync_aggregate",
+        "execution_payload",
+        "bls_to_execution_changes",
+        "blob_kzg_commitments",
+    ]
+    # quoted integers, hex bytes — the API wire conventions
+    assert j["slot"] == "9"
+    ep = j["body"]["execution_payload"]
+    assert ep["base_fee_per_gas"] == str(2**130 + 7)
+    assert ep["transactions"][0] == "0x02f871"
+    # aggregation_bits is the SSZ bitlist encoding (delimiter bit)
+    assert j["body"]["attestations"][0]["aggregation_bits"] == "0x0d"
+
+
+def test_block_root_equals_header_root():
+    blk = _rich_deneb_block()
+    assert blk.hash_tree_root() == blk.header().hash_tree_root()
+    # and the body_root actually commits to the body contents
+    import dataclasses
+
+    payload2 = dataclasses.replace(blk.body.execution_payload, gas_used=1)
+    body2 = dataclasses.replace(blk.body, execution_payload=payload2)
+    blk2 = dataclasses.replace(blk, body=body2)
+    assert blk2.header().body_root != blk.header().body_root
+
+
+def test_ssz_micro_kats():
+    # uint256 root is the 32-byte little-endian value
+    assert ssz.Uint256().hash_tree_root(1) == b"\x01" + bytes(31)
+    # empty bitlist encodes as just the delimiter bit
+    from charon_tpu.eth2util.spec import bits_from_bytes, bits_to_bytes
+
+    assert bits_to_bytes((), sentinel=True) == b"\x01"
+    assert bits_from_bytes(b"\x01", sentinel=True) == ()
+    assert bits_to_bytes((True,), sentinel=True) == b"\x03"
+    assert bits_from_bytes(b"\x03", sentinel=True) == (True,)
+    with pytest.raises(ValueError):
+        bits_from_bytes(b"", sentinel=True)
+
+
+def test_proposal_contents_shapes():
+    blk = _rich_deneb_block()
+    full = Proposal("deneb", blk, kzg_proofs=(b"\x01" * 48,), blobs=(b"\x02" * 131072,))
+    d = proposal_data_json(full)
+    assert set(d) == {"block", "kzg_proofs", "blobs"}  # deneb contents
+    assert proposal_from_data_json("deneb", False, d) == full
+
+    blinded_blk = _mk_block(spec.BlindedBeaconBlockDeneb)
+    blinded = Proposal("deneb", blinded_blk, blinded=True)
+    d = proposal_data_json(blinded)
+    assert "block" not in d and d["slot"] == "9"  # bare block shape
+    assert proposal_from_data_json("deneb", True, d) == blinded
+
+    cap = Proposal("capella", _mk_block(spec.BeaconBlockCapella))
+    assert "block" not in proposal_data_json(cap)
+    assert "capella" not in FORKS_WITH_CONTENTS
+
+
+def test_signed_proposal_roundtrip_and_sniffing():
+    sig = b"\x2a" * 96
+    full = Proposal("deneb", _rich_deneb_block())
+    j = signed_proposal_json(full, sig)
+    assert set(j) == {"signed_block", "kzg_proofs", "blobs"}
+    p2, s2 = signed_proposal_from_json(j, blinded=False, version="deneb")
+    assert (p2, s2) == (full, sig)
+
+    # no version header: the body field set discriminates the fork
+    cap = Proposal("capella", _mk_block(spec.BeaconBlockCapella))
+    j = signed_proposal_json(cap, sig)
+    assert sniff_block_version(j["message"]) == "capella"
+    p2, s2 = signed_proposal_from_json(j, blinded=False)
+    assert p2.version == "capella" and p2 == cap
+
+
+def test_proposal_wire_codec_roundtrip():
+    """Fork-versioned proposals ride the consensus/parsigex wire intact
+    (ref: corepb carries the full VersionedProposal across peers)."""
+    from charon_tpu.p2p import codec
+
+    p = Proposal(
+        "deneb",
+        _rich_deneb_block(),
+        kzg_proofs=(b"\x01" * 48,),
+        blobs=(b"\x02" * 64,),
+    )
+    assert codec.decode(codec.encode(p)) == p
+    blinded = Proposal(
+        "capella", _mk_block(spec.BlindedBeaconBlockCapella), blinded=True
+    )
+    assert codec.decode(codec.encode(blinded)) == blinded
+
+
+class _RecordingVapi:
+    """Just enough ValidatorAPI surface for VapiRouter's proposer path."""
+
+    def __init__(self, defs, valid_pubkey, proposal):
+        self.pubshares = {}
+        self._defs = defs
+        self._valid = valid_pubkey
+        self._proposal = proposal
+        self.randao_calls = []
+        self.submitted = []
+
+    def _duty_defs(self, duty):
+        return self._defs
+
+    async def submit_randao(self, slot, pubkey, sig):
+        self.randao_calls.append(pubkey)
+        if pubkey != self._valid:
+            raise VapiError("randao partial does not verify for this share")
+
+    async def proposal(self, slot, pubkey):
+        assert pubkey == self._valid
+        return self._proposal
+
+    async def submit_proposal(self, pubkey, proposal, signature):
+        self.submitted.append((pubkey, proposal, signature))
+
+
+def test_router_keys_proposer_by_pubkey():
+    """Two cluster validators proposing in the SAME slot: the randao
+    reveal selects the right pubkey on produce, and the block's
+    proposer_index selects it on submit (never `next(iter(defs))`)."""
+    from charon_tpu.core.vapi_http import VapiRouter
+
+    pk_a, pk_b = pubkey_from_bytes(b"\xaa" * 48), pubkey_from_bytes(b"\xbb" * 48)
+    blk = _rich_deneb_block()  # proposer_index=4
+    prop = Proposal("deneb", blk)
+    vapi = _RecordingVapi({pk_a: None, pk_b: None}, pk_b, prop)
+
+    async def main():
+        router = VapiRouter(
+            vapi, validators={pk_a: 3, pk_b: 4}, slot_duration=1.0
+        )
+        port = await router.start()
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            base = f"http://127.0.0.1:{port}"
+            async with s.get(
+                f"{base}/eth/v3/validator/blocks/9",
+                params={"randao_reveal": "0x" + "03" * 96},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+                j = await resp.json()
+                assert j["version"] == "deneb"
+                assert resp.headers["Eth-Consensus-Version"] == "deneb"
+            # the reveal verified only for pk_b; both may have been tried
+            assert vapi.randao_calls and vapi.randao_calls[-1] == pk_b
+
+            # submit: proposer_index 4 -> pk_b
+            async with s.post(
+                f"{base}/eth/v2/beacon/blocks",
+                json=signed_proposal_json(prop, b"\x2b" * 96),
+                headers={"Eth-Consensus-Version": "deneb"},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            assert vapi.submitted[0][0] == pk_b
+
+            # unknown proposer index -> 404, nothing submitted
+            import dataclasses
+
+            other = Proposal("deneb", dataclasses.replace(blk, proposer_index=77))
+            async with s.post(
+                f"{base}/eth/v2/beacon/blocks",
+                json=signed_proposal_json(other, b"\x2c" * 96),
+                headers={"Eth-Consensus-Version": "deneb"},
+            ) as resp:
+                assert resp.status == 404
+            assert len(vapi.submitted) == 1
+        await router.stop()
+
+    asyncio.run(main())

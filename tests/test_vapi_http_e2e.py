@@ -147,7 +147,7 @@ def test_http_e2e_all_duties():
         proposal, psig = beacon.proposals[0]
         assert len({s for _, s in beacon.proposals[:4]}) == 1
         proot = SignedData("block", proposal).signing_root(
-            cluster.fork, proposal.header.slot // spe
+            cluster.fork, proposal.slot // spe
         )
         tbls.verify(pubkey_to_bytes(group_pk), proot, psig)
 
