@@ -214,7 +214,16 @@ def fp2_batch(ctx, ops):
 
     All operands must share a batch shape. Returns the list of fp2 results
     in order.
+
+    On the Pallas path the mul/sqr ops run as FUSED VMEM kernels
+    (ops/pallas_mont.py fp2_mul_pallas/fp2_sqr_pallas): prep sums, the
+    Montgomery multiplies, and the Karatsuba recombination never leave
+    VMEM — the XLA path below round-trips HBM between each stacked
+    normalize and the base multiply, which is where the engine was
+    measured HBM-bound (PERF.md).
     """
+    if limb._pallas_active(ctx):
+        return _fp2_batch_pallas(ctx, ops)
     # prep level: every Karatsuba sum / squaring sum+difference in ONE
     # stacked normalize
     prep_adds, prep_subs = [], []
@@ -288,6 +297,51 @@ def fp2_batch(ctx, ops):
         else:  # mul_fp
             out.append((prods[i], prods[i + 1]))
             i += 2
+    return out
+
+
+def _fp2_batch_pallas(ctx, ops):
+    """fp2_batch on the fused kernels: stack same-kind ops along a new
+    leading axis so each kernel family compiles once per shape."""
+    from charon_tpu.ops import pallas_mont as PK
+
+    out = [None] * len(ops)
+    muls = [(i, op) for i, op in enumerate(ops) if op[0] == "mul"]
+    sqrs = [(i, op) for i, op in enumerate(ops) if op[0] == "sqr"]
+    mulfps = [(i, op) for i, op in enumerate(ops) if op[0] == "mul_fp"]
+    if len(muls) + len(sqrs) + len(mulfps) != len(ops):
+        raise ValueError("unknown fp2_batch op")
+
+    if muls:
+        sa0, sa1, sb0, sb1 = [], [], [], []
+        for _, (_, a, b) in muls:
+            x0, x1, y0, y1 = jnp.broadcast_arrays(a[0], a[1], b[0], b[1])
+            sa0.append(x0), sa1.append(x1), sb0.append(y0), sb1.append(y1)
+        c0, c1 = PK.fp2_mul_pallas(
+            ctx,
+            (jnp.stack(jnp.broadcast_arrays(*sa0)), jnp.stack(jnp.broadcast_arrays(*sa1))),
+            (jnp.stack(jnp.broadcast_arrays(*sb0)), jnp.stack(jnp.broadcast_arrays(*sb1))),
+        )
+        for j, (i, _) in enumerate(muls):
+            out[i] = (c0[j], c1[j])
+
+    if sqrs:
+        sa0 = jnp.stack(jnp.broadcast_arrays(*(op[1][0] for _, op in sqrs)))
+        sa1 = jnp.stack(jnp.broadcast_arrays(*(op[1][1] for _, op in sqrs)))
+        c0, c1 = PK.fp2_sqr_pallas(ctx, (sa0, sa1))
+        for j, (i, _) in enumerate(sqrs):
+            out[i] = (c0[j], c1[j])
+
+    if mulfps:
+        xs, ys = [], []
+        for _, (_, a, s) in mulfps:
+            xs += [a[0], a[1]]
+            ys += [s, s]
+        prods = limb.mont_mul(
+            ctx, jnp.stack(jnp.broadcast_arrays(*xs)), jnp.stack(jnp.broadcast_arrays(*ys))
+        )
+        for j, (i, _) in enumerate(mulfps):
+            out[i] = (prods[2 * j], prods[2 * j + 1])
     return out
 
 

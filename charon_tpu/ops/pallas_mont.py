@@ -21,6 +21,7 @@ Replaces (batched, fused) the role of herumi's asm field multiply
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 
 import numpy as np
 import jax
@@ -108,22 +109,48 @@ def _conv_into(acc, a, b_row, n: int, out_cols: int):
     return acc
 
 
-def _mont_kernel_body(
-    ctx: ModCtx, a_ref, b_ref, consts_ref, out_ref
-):
-    """consts_ref rows: 0 = ninv, 1 = p (n cols); 2..3 = R - p shifted
-    into the high half (2n cols packed as two n-col rows)."""
-    n = ctx.n_limbs
-    nbits = ctx.limb_bits
-    mask = jnp.uint32((1 << nbits) - 1)
-    a = a_ref[:]
-    b = b_ref[:]
+def _flag01(carry):
+    """Collapse a small (<8) carry count to a 0/1 u32 flag — arithmetic
+    select helper (no i1 vectors, no unsigned-min: both mis-lower in
+    Mosaic)."""
+    return (carry | (carry >> 1) | (carry >> 2)) & jnp.uint32(1)
+
+
+@dataclass(frozen=True)
+class _K:
+    """Per-kernel constant bundle (everything the VMEM helpers need)."""
+
+    n: int
+    nbits: int
+    mask: jnp.ndarray
+    ninv: jnp.ndarray  # (1, n)
+    p_row: jnp.ndarray  # (1, n)
+    rm2n: jnp.ndarray  # (1, 2n): R - p in the high half
+    rm_n: jnp.ndarray  # (1, n): R - p (R = 2^(nbits*n))
+    one0: jnp.ndarray  # (1, n): one-hot limb 0
+
+
+def _unpack_consts(ctx: ModCtx, consts_ref) -> _K:
+    """consts_ref rows: 0 = ninv, 1 = p; 2..3 = R - p shifted into the
+    high half (2n cols packed as two n-col rows; row 3 alone is the
+    n-col R - p); 4 = one-hot limb 0."""
+    return _K(
+        n=ctx.n_limbs,
+        nbits=ctx.limb_bits,
+        mask=jnp.uint32((1 << ctx.limb_bits) - 1),
+        ninv=consts_ref[0:1, :],
+        p_row=consts_ref[1:2, :],
+        rm2n=jnp.concatenate([consts_ref[2:3, :], consts_ref[3:4, :]], axis=1),
+        rm_n=consts_ref[3:4, :],
+        one0=consts_ref[4:5, :],
+    )
+
+
+def _mont_core(k: _K, a, b):
+    """Full Montgomery multiply in VMEM: canonical n-limb result
+    (mirrors limb.mont_mul's separated-operand algorithm step for step)."""
     rows = a.shape[0]
-    ninv = consts_ref[0:1, :]
-    p_row = consts_ref[1:2, :]
-    rm = jnp.concatenate(
-        [consts_ref[2:3, :], consts_ref[3:4, :]], axis=1
-    )  # (1, 2n)
+    n, nbits, mask = k.n, k.nbits, k.mask
 
     # 1. t = a * b over 2n columns
     t = jnp.zeros((rows, 2 * n), jnp.uint32)
@@ -132,40 +159,98 @@ def _mont_kernel_body(
 
     # 2. m = (t mod R) * (-p^-1 mod R) mod R
     m = jnp.zeros((rows, n), jnp.uint32)
-    m = _conv_into(m, t[:, :n], jnp.broadcast_to(ninv, (rows, n)), n, n)
+    m = _conv_into(m, t[:, :n], jnp.broadcast_to(k.ninv, (rows, n)), n, n)
     m, _ = _normalize(m, nbits, mask, n)
 
     # 3. s = t + m * p; final normalize fused with the conditional
     # subtract: lane2 adds (R - p) into the high columns, carry-out of
-    # lane2 says hi >= p (mirrors limb.mont_mul exactly)
-    s = t
-    s = _conv_into(s, m, jnp.broadcast_to(p_row, (rows, n)), n, 2 * n)
-    s2 = s + rm
+    # lane2 says hi >= p
+    s = _conv_into(t, m, jnp.broadcast_to(k.p_row, (rows, n)), n, 2 * n)
+    s2 = s + k.rm2n
 
     out1, _ = _normalize(s, nbits, mask, 2 * n)
     out2, carry2 = _normalize(s2, nbits, mask, 2 * n)
-    # arithmetic select (no i1 vectors, no unsigned-min — both mis-lower
-    # in Mosaic): carry2 <= 4, collapse its bits to a 0/1 flag; uint32
-    # wraparound in the difference cancels exactly when flag == 1
-    flag = (carry2 | (carry2 >> 1) | (carry2 >> 2)) & jnp.uint32(1)
+    flag = _flag01(carry2)
     hi1 = out1[:, n:]
     hi2 = out2[:, n:]
-    out_ref[:] = hi1 + (hi2 - hi1) * flag
+    return hi1 + (hi2 - hi1) * flag
+
+
+def _mod_add(k: _K, x, y):
+    """x + y mod p in VMEM (canonical inputs): raw lane + (R - p)
+    adjustment lane, select on the adjusted lane's carry-out — the same
+    trick as limb.addsub_mod_many."""
+    s = x + y
+    out1, _ = _normalize(s, k.nbits, k.mask, k.n)
+    out2, c2 = _normalize(s + k.rm_n, k.nbits, k.mask, k.n)
+    flag = _flag01(c2)
+    return out1 + (out2 - out1) * flag
+
+
+def _mod_sub(k: _K, x, y):
+    """x - y mod p in VMEM: z = x + (R - 1 - y) + 1; carry-out of z says
+    x >= y (take z), else take z + p."""
+    z = x + (k.mask - y) + k.one0
+    out1, c1 = _normalize(z, k.nbits, k.mask, k.n)
+    out2, _ = _normalize(z + k.p_row, k.nbits, k.mask, k.n)
+    flag = _flag01(c1)
+    return out2 + (out1 - out2) * flag
+
+
+def _mont_kernel_body(ctx: ModCtx, a_ref, b_ref, consts_ref, out_ref):
+    k = _unpack_consts(ctx, consts_ref)
+    out_ref[:] = _mont_core(k, a_ref[:], b_ref[:])
+
+
+def _fp2_mul_kernel_body(
+    ctx: ModCtx, a0_ref, a1_ref, b0_ref, b1_ref, consts_ref, c0_ref, c1_ref
+):
+    """Whole Karatsuba Fp2 multiply fused in VMEM: the prep sums, three
+    Montgomery multiplies, and the recombination never touch HBM —
+    c0 = a0 b0 - a1 b1, c1 = (a0+a1)(b0+b1) - a0 b0 - a1 b1.
+
+    This is the Miller loop's dominant op (~90% of pairing field work);
+    the unfused path round-trips HBM between every stacked normalize and
+    mont_mul (PERF.md 'Where the remaining gap is')."""
+    k = _unpack_consts(ctx, consts_ref)
+    a0, a1, b0, b1 = a0_ref[:], a1_ref[:], b0_ref[:], b1_ref[:]
+    ta = _mod_add(k, a0, a1)
+    tb = _mod_add(k, b0, b1)
+    v0 = _mont_core(k, a0, b0)
+    v1 = _mont_core(k, a1, b1)
+    s = _mont_core(k, ta, tb)
+    c0_ref[:] = _mod_sub(k, v0, v1)
+    c1_ref[:] = _mod_sub(k, s, _mod_add(k, v0, v1))
+
+
+def _fp2_sqr_kernel_body(
+    ctx: ModCtx, a0_ref, a1_ref, consts_ref, c0_ref, c1_ref
+):
+    """Fused Fp2 square: c0 = (a0+a1)(a0-a1), c1 = 2 a0 a1 — two
+    Montgomery multiplies, all in VMEM."""
+    k = _unpack_consts(ctx, consts_ref)
+    a0, a1 = a0_ref[:], a1_ref[:]
+    ta = _mod_add(k, a0, a1)
+    ts = _mod_sub(k, a0, a1)
+    c0_ref[:] = _mont_core(k, ta, ts)
+    w = _mont_core(k, a0, a1)
+    c1_ref[:] = _mod_add(k, w, w)
 
 
 @functools.lru_cache(maxsize=None)
 def _ctx_consts(ctx: ModCtx) -> np.ndarray:
-    """(4, n) constant rows: ninv, p, (R-p) low half, (R-p) high half —
-    where "(R-p) shifted into high columns" means rows 2..3 concatenate
-    to the 2n-col adjustment lane."""
+    """(5, n) constant rows: ninv, p, (R-p) low half, (R-p) high half,
+    one-hot limb 0 — rows 2..3 concatenate to the 2n-col adjustment lane
+    (row 3 alone is the n-col R - p used by the mod-add helper)."""
     n = ctx.n_limbs
-    out = np.zeros((4, n), np.uint32)
+    out = np.zeros((5, n), np.uint32)
     out[0] = np.asarray(ctx.ninv, np.uint32)
     out[1] = np.asarray(ctx.limbs, np.uint32)
     rm2n = np.zeros(2 * n, np.uint32)
     rm2n[n:] = np.asarray(_r_minus_m(ctx), np.uint32)
     out[2] = rm2n[:n]
     out[3] = rm2n[n:]
+    out[4, 0] = 1
     return out
 
 
@@ -189,6 +274,76 @@ def _mont_call(ctx: ModCtx, interpret: bool):
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _fp2_call(ctx: ModCtx, kind: str, interpret: bool):
+    """Gridless pallas_call for the fused Fp2 kernels (same lax.map
+    chunking strategy as the mont kernel)."""
+    n = ctx.n_limbs
+    out_shape = (
+        jax.ShapeDtypeStruct((TILE, n), jnp.uint32),
+        jax.ShapeDtypeStruct((TILE, n), jnp.uint32),
+    )
+    if kind == "mul":
+        body = functools.partial(_fp2_mul_kernel_body, ctx)
+        n_in = 5
+    else:
+        body = functools.partial(_fp2_sqr_kernel_body, ctx)
+        n_in = 3
+    return pl.pallas_call(
+        body,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )
+
+
+def _run_fp2(ctx: ModCtx, kind: str, operands, interpret: bool):
+    """Flatten/pad a list of (..., n) operand arrays to TILE-row chunks
+    and run the fused kernel; returns the two (..., n) outputs."""
+    if ctx.np_dtype is not np.uint32:
+        raise ValueError("pallas fp2 kernels require the uint32 limb geometry")
+    operands = jnp.broadcast_arrays(*operands)
+    batch_shape = operands[0].shape[:-1]
+    n = ctx.n_limbs
+    flats = [o.reshape(-1, n) for o in operands]
+    rows = flats[0].shape[0]
+    padded = -(-rows // TILE) * TILE
+    if padded != rows:
+        flats = [jnp.pad(f, ((0, padded - rows), (0, 0))) for f in flats]
+    consts = jnp.asarray(_ctx_consts(ctx))
+    call = _fp2_call(ctx, kind, interpret)
+    if padded == TILE:
+        c0, c1 = call(*flats, consts)
+    else:
+        chunks = padded // TILE
+        c0, c1 = jax.lax.map(
+            lambda xs: call(*xs, consts),
+            tuple(f.reshape(chunks, TILE, n) for f in flats),
+        )
+        c0 = c0.reshape(padded, n)
+        c1 = c1.reshape(padded, n)
+    return (
+        c0[:rows].reshape(*batch_shape, n),
+        c1[:rows].reshape(*batch_shape, n),
+    )
+
+
+def fp2_mul_pallas(ctx: ModCtx, a, b, interpret: bool = False):
+    """Fused Fp2 Karatsuba multiply: a, b are (c0, c1) tuples of reduced
+    Montgomery limb arrays; returns the product tuple. Drop-in for
+    ops/fptower.fp2_mul on the uint32 geometry."""
+    return _run_fp2(ctx, "mul", (a[0], a[1], b[0], b[1]), interpret)
+
+
+def fp2_sqr_pallas(ctx: ModCtx, a, interpret: bool = False):
+    """Fused Fp2 square; drop-in for ops/fptower.fp2_sqr."""
+    return _run_fp2(ctx, "sqr", (a[0], a[1]), interpret)
 
 
 def mont_mul_pallas(ctx: ModCtx, a, b, interpret: bool = False):
