@@ -55,6 +55,22 @@ class ClusterMetrics:
         self.tracker_failed = counter(
             "core_tracker_failed_duties_total", "Failed duties", ["duty", "step"]
         )
+        self.tracker_inconsistent = counter(
+            "core_tracker_inconsistent_parsigs_total",
+            "Duties with inconsistent partial signatures by duty type "
+            "(ref: core/tracker/metrics.go:85)",
+            ["duty"],
+        )
+        self.tracker_unexpected = counter(
+            "core_tracker_unexpected_events_total",
+            "Partial signatures from peers for unscheduled validators",
+            ["peer_share"],
+        )
+        self.tracker_participation = counter(
+            "core_tracker_participation_total",
+            "Per-peer duty participation (dedup'd by validator)",
+            ["duty", "peer_share"],
+        )
         self.peer_ping = Gauge(
             "p2p_ping_success",
             "Peer ping success",

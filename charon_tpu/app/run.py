@@ -326,6 +326,33 @@ async def build_node(config: Config) -> Node:
         options=[tracking(tracker), tracer.tracing(), instrument(metrics)],
     )
 
+    # tracker reports -> metrics: failures, participation counts,
+    # inconsistent partials, unexpected peers (ref: core/tracker
+    # newFailedDutyReporter / newParticipationReporter / reportParSigs)
+    def _report_metrics(report):
+        d = str(report.duty.type.name).lower()
+        if not report.success and report.failed_step is not None:
+            metrics.labels(
+                metrics.tracker_failed, d, str(report.failed_step)
+            ).inc()
+        if report.inconsistent_pubkeys:
+            metrics.labels(metrics.tracker_inconsistent, d).inc()
+        for share, cnt in report.participation_counts.items():
+            metrics.labels(
+                metrics.tracker_participation, d, str(share)
+            ).inc(cnt)
+        for share, cnt in report.unexpected_shares.items():
+            metrics.labels(metrics.tracker_unexpected, str(share)).inc(cnt)
+            log.warn(
+                "unexpected peer participation",
+                topic="tracker",
+                duty=str(report.duty),
+                peer_share=share,
+                count=cnt,
+            )
+
+    tracker.subscribe(_report_metrics)
+
     # deadliner trims stores + triggers tracker analysis
     deadliner = Deadliner(
         clock,

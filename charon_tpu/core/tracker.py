@@ -1,10 +1,16 @@
-"""Tracker: per-duty failure detection and peer participation.
+"""Tracker: per-duty failure detection, partial-signature consistency,
+and peer participation.
 
 Mirrors ref: core/tracker — every workflow component emits an event per
 duty step (step enum tracker.go:20-34); when the Deadliner expires a duty
 the tracker determines the first failing step and a reason
-(tracker.go:103, reasons reason.go), plus per-peer participation from the
-partial signatures observed (tracker.go:106) and unexpected-peer checks.
+(tracker.go:154, reasons reason.go), groups the observed partial
+signatures by message root per pubkey to detect inconsistent partials
+(tracker.go:59-71 parsigsByMsg + MsgRootsConsistent, metrics.go:85
+inconsistent_parsigs_total), and reports per-peer participation counts
+plus UNEXPECTED peers — shares that submitted partials for a duty that
+was never scheduled for that validator (tracker.go:539-573
+analyseParticipation).
 
 Wiring: `tracking(tracker)` is a wire() option that wraps every
 subscription edge (ref: core/tracking.go wraps via core.WithTracking).
@@ -17,7 +23,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
-from charon_tpu.core.types import Duty, PubKey
+from charon_tpu.core.types import Duty, DutyType, PubKey
 
 
 class Step(enum.IntEnum):
@@ -56,16 +62,47 @@ _EDGE_STEPS: dict[str, tuple[Step, ...]] = {
 
 
 class Reason(str, enum.Enum):
-    """Failure reasons (ref: core/tracker/reason.go)."""
+    """Failure reasons with ref-parity codes (ref: core/tracker/reason.go
+    — each reason there carries Code/Short/Long; the enum VALUE here is
+    the code, `describe()` the operator-facing text)."""
 
-    NOT_SCHEDULED = "duty was never scheduled"
-    FETCH_FAILED = "failed to fetch duty data from the beacon node"
-    NO_CONSENSUS = "consensus was not reached"
-    NO_LOCAL_PARTIAL = "validator client did not submit a partial signature"
-    INSUFFICIENT_PARTIALS = "insufficient partial signatures from peers"
-    AGGREGATION_FAILED = "threshold aggregation or verification failed"
-    BROADCAST_FAILED = "failed to broadcast to the beacon node"
-    UNKNOWN = "unexpected failure"
+    NOT_SCHEDULED = "not_scheduled"
+    FETCH_BN_ERROR = "fetch_bn_error"
+    FETCH_FAILED = "bug_fetch_error"
+    RANDAO_FAILED = "randao_failed"
+    PREPARE_AGGREGATOR_FAILED = "prepare_aggregator_failed"
+    PREPARE_SYNC_CONTRIBUTION_FAILED = "prepare_sync_contribution_failed"
+    NO_CONSENSUS = "no_consensus"
+    NO_LOCAL_PARTIAL = "no_local_vc_signature"
+    NO_PEER_SIGNATURES = "no_peer_signatures"
+    INSUFFICIENT_PARTIALS = "insufficient_peer_signatures"
+    PARSIG_INCONSISTENT = "bug_par_sig_db_inconsistent"
+    PARSIG_INCONSISTENT_SYNC = "par_sig_db_inconsistent_sync"
+    AGGREGATION_FAILED = "bug_sig_agg"
+    BROADCAST_FAILED = "broadcast_bn_error"
+    UNKNOWN = "unknown"
+
+    def describe(self) -> str:
+        return _REASON_TEXT[self]
+
+
+_REASON_TEXT = {
+    Reason.NOT_SCHEDULED: "duty was never scheduled",
+    Reason.FETCH_BN_ERROR: "the beacon node returned an error fetching duty data",
+    Reason.FETCH_FAILED: "failed to fetch duty data from the beacon node",
+    Reason.RANDAO_FAILED: "the proposal could not be fetched because the randao duty failed",
+    Reason.PREPARE_AGGREGATOR_FAILED: "the aggregation could not start because the prepare-aggregator duty failed",
+    Reason.PREPARE_SYNC_CONTRIBUTION_FAILED: "the contribution could not start because the prepare-sync-contribution duty failed",
+    Reason.NO_CONSENSUS: "consensus was not reached",
+    Reason.NO_LOCAL_PARTIAL: "validator client did not submit a partial signature",
+    Reason.NO_PEER_SIGNATURES: "no partial signatures received from peers",
+    Reason.INSUFFICIENT_PARTIALS: "insufficient partial signatures from peers",
+    Reason.PARSIG_INCONSISTENT: "bug: inconsistent partial signatures received",
+    Reason.PARSIG_INCONSISTENT_SYNC: "known limitation: inconsistent sync committee signatures received",
+    Reason.AGGREGATION_FAILED: "threshold aggregation or verification failed",
+    Reason.BROADCAST_FAILED: "failed to broadcast to the beacon node",
+    Reason.UNKNOWN: "unexpected failure",
+}
 
 
 _FAIL_REASONS = {
@@ -75,11 +112,39 @@ _FAIL_REASONS = {
     Step.DUTY_DB: Reason.NO_LOCAL_PARTIAL,
     Step.VALIDATOR_API: Reason.NO_LOCAL_PARTIAL,
     Step.PARSIG_DB_INTERNAL: Reason.INSUFFICIENT_PARTIALS,
-    Step.PARSIG_EX: Reason.INSUFFICIENT_PARTIALS,
-    Step.PARSIG_DB_THRESHOLD: Reason.AGGREGATION_FAILED,
+    Step.PARSIG_EX: Reason.NO_PEER_SIGNATURES,
+    Step.PARSIG_DB_THRESHOLD: Reason.INSUFFICIENT_PARTIALS,
     Step.SIG_AGG: Reason.AGGREGATION_FAILED,
     Step.AGG_SIG_DB: Reason.AGGREGATION_FAILED,
     Step.BCAST: Reason.BROADCAST_FAILED,
+}
+
+# Duty types whose partial signatures legitimately disagree across peers
+# (each sync-committee member may see a different head — ref: tracker.go
+# expectInconsistentParSigs).
+_EXPECT_INCONSISTENT = {DutyType.SYNC_MESSAGE, DutyType.SYNC_CONTRIBUTION}
+
+# VC-triggered duties with no locally scheduled definition — their
+# partials can never be classified unexpected (ref: tracker.go
+# isParSigEventExpected: DutyExit / DutyBuilderRegistration).
+_UNSCHEDULED_TYPES = {
+    DutyType.EXIT,
+    DutyType.BUILDER_REGISTRATION,
+    DutyType.SIGNATURE,
+}
+
+# Duties whose fetch depends on a prerequisite duty in the same slot
+# (ref: tracker.go analyseFetcherFailedProposer/-Aggregator/-SyncContribution).
+_FETCH_PREREQ = {
+    DutyType.PROPOSER: (DutyType.RANDAO, Reason.RANDAO_FAILED),
+    DutyType.AGGREGATOR: (
+        DutyType.PREPARE_AGGREGATOR,
+        Reason.PREPARE_AGGREGATOR_FAILED,
+    ),
+    DutyType.SYNC_CONTRIBUTION: (
+        DutyType.PREPARE_SYNC_CONTRIBUTION,
+        Reason.PREPARE_SYNC_CONTRIBUTION_FAILED,
+    ),
 }
 
 
@@ -91,9 +156,36 @@ class DutyReport:
     reason: Reason | None
     participation: dict[int, bool]  # share_idx -> partial sig seen
     errors: list[str] = field(default_factory=list)
+    # per-share dedup'd (pubkey, share) participation counts and the
+    # expected count per peer (== number of scheduled validators)
+    participation_counts: dict[int, int] = field(default_factory=dict)
+    expected_per_peer: int = 0
+    # share_idx -> number of partials for validators with no scheduled
+    # duty (ref: analyseParticipation unexpectedShares)
+    unexpected_shares: dict[int, int] = field(default_factory=dict)
+    # pubkeys whose partials arrived under more than one message root
+    inconsistent_pubkeys: list[PubKey] = field(default_factory=list)
 
 
 ReportSub = Callable[[DutyReport], Awaitable[None] | None]
+
+
+def _parsig_root(psig) -> bytes:
+    """Message root of a ParSignedData for consistency grouping —
+    delegates to the object's own message_root() (also used by parsigdb
+    when grouping the same partial). The fallback digests ONLY the kind
+    and payload: hashing anything containing the per-share signature
+    would give every peer a unique root and flag consistent duties as
+    inconsistent."""
+    try:
+        return psig.message_root()
+    except Exception:  # noqa: BLE001 — never let tracking break the flow
+        import hashlib
+
+        sd = getattr(psig, "data", psig)
+        return hashlib.sha256(
+            repr((getattr(sd, "kind", None), getattr(sd, "payload", sd))).encode()
+        ).digest()
 
 
 class Tracker:
@@ -102,12 +194,23 @@ class Tracker:
     def __init__(self, peer_share_indices: list[int]) -> None:
         self.peer_share_indices = list(peer_share_indices)
         self._steps: dict[Duty, set[Step]] = defaultdict(set)
-        self._participation: dict[Duty, set[int]] = defaultdict(set)
         self._errors: dict[Duty, list[str]] = defaultdict(list)
+        # duty -> pubkey -> msg root -> set of share indices
+        # (ref: tracker.go parsigsByMsg)
+        self._parsigs: dict[Duty, dict[PubKey, dict[bytes, set[int]]]] = (
+            defaultdict(lambda: defaultdict(lambda: defaultdict(set)))
+        )
+        # duty -> pubkeys with a locally scheduled definition
+        self._expected: dict[Duty, set[PubKey]] = defaultdict(set)
+        # failure memory for prerequisite analysis (randao -> proposer)
+        self._failed_steps: dict[Duty, Step] = {}
         self._subs: list[ReportSub] = []
+        # counters (exported through app/metrics + monitoring endpoint)
         self.failed_total: dict[tuple, int] = defaultdict(int)
         self.success_total: dict[Duty, int] = {}
         self.participation_total: dict[int, int] = defaultdict(int)
+        self.inconsistent_total: dict[DutyType, int] = defaultdict(int)
+        self.unexpected_total: dict[int, int] = defaultdict(int)
 
     def subscribe(self, sub: ReportSub) -> None:
         self._subs.append(sub)
@@ -120,16 +223,51 @@ class Tracker:
     def step_failed(self, duty: Duty, step: Step, err: Exception) -> None:
         self._errors[duty].append(f"{step}: {err}")
 
-    def partial_observed(self, duty: Duty, share_idx: int) -> None:
-        self._participation[duty].add(share_idx)
+    def duty_scheduled(self, duty: Duty, pubkeys) -> None:
+        """Record which validators this duty was scheduled for — the
+        baseline for unexpected-peer detection."""
+        self._expected[duty].update(pubkeys)
 
-    # -- analysis at duty expiry (ref: tracker.go:103) --------------------
+    def partial_observed(
+        self, duty: Duty, share_idx: int, pubkey=None, root: bytes | None = None
+    ) -> None:
+        self._parsigs[duty][pubkey][root or b""].add(share_idx)
+
+    # -- analysis at duty expiry (ref: tracker.go:147-163) ----------------
 
     async def duty_expired(self, duty: Duty) -> DutyReport:
         steps = self._steps.pop(duty, set())
-        participation = self._participation.pop(duty, set())
+        parsigs = self._parsigs.pop(duty, {})
+        expected = self._expected.pop(duty, set())
         errors = self._errors.pop(duty, [])
         success = Step.BCAST in steps
+
+        # parsig consistency: more than one message root for one pubkey
+        # (ref: parsigsByMsg.MsgRootsConsistent)
+        inconsistent = [
+            pk for pk, roots in parsigs.items() if len(roots) > 1
+        ]
+        if inconsistent:
+            self.inconsistent_total[duty.type] += 1
+
+        # participation + unexpected peers (ref: analyseParticipation):
+        # dedup by (pubkey, share); a partial for a pubkey with no
+        # scheduled definition is unexpected rather than participation
+        counts: dict[int, int] = defaultdict(int)
+        unexpected: dict[int, int] = defaultdict(int)
+        check_unexpected = (
+            duty.type not in _UNSCHEDULED_TYPES and expected
+        )
+        for pk, roots in parsigs.items():
+            shares = set().union(*roots.values())
+            if check_unexpected and pk is not None and pk not in expected:
+                for idx in shares:
+                    unexpected[idx] += 1
+                    self.unexpected_total[idx] += 1
+                continue
+            for idx in shares:
+                counts[idx] += 1
+        participation = set(counts)
 
         failed_step = None
         reason = None
@@ -140,7 +278,41 @@ class Tracker:
                     failed_step = step
                     reason = _FAIL_REASONS.get(step, Reason.UNKNOWN)
                     break
+            # refinement: threshold/aggregation failures with
+            # inconsistent partials are a distinct (bug-class) reason —
+            # except sync-committee duties where disagreement is expected
+            if (
+                failed_step
+                in (Step.PARSIG_DB_THRESHOLD, Step.SIG_AGG)
+                and inconsistent
+            ):
+                reason = (
+                    Reason.PARSIG_INCONSISTENT_SYNC
+                    if duty.type in _EXPECT_INCONSISTENT
+                    else Reason.PARSIG_INCONSISTENT
+                )
+            # refinement: an error recorded at the fetch step is the
+            # beacon node failing us (infrastructure), a silent stall is
+            # the bug-class reason (ref: analyseFetcherFailed)
+            if failed_step == Step.FETCHER and any(
+                e.startswith(str(Step.FETCHER)) for e in errors
+            ):
+                reason = Reason.FETCH_BN_ERROR
+            # refinement: a fetch-stage failure of a dependent duty is
+            # attributed to its failed prerequisite (randao -> proposer);
+            # takes precedence over the BN-error classification, matching
+            # ref analyseFetcherFailedProposer
+            if failed_step == Step.FETCHER and duty.type in _FETCH_PREREQ:
+                prereq_type, prereq_reason = _FETCH_PREREQ[duty.type]
+                prereq = Duty(duty.slot, prereq_type)
+                if self._failed_steps.get(prereq) is not None:
+                    reason = prereq_reason
             self.failed_total[(duty.type, failed_step)] += 1
+            self._failed_steps[duty] = failed_step
+            # bounded memory: only same-slot prerequisites consult this
+            if len(self._failed_steps) > 1024:
+                for k in list(self._failed_steps)[:512]:
+                    self._failed_steps.pop(k, None)
 
         part_map = {
             idx: idx in participation for idx in self.peer_share_indices
@@ -155,6 +327,10 @@ class Tracker:
             reason=reason,
             participation=part_map,
             errors=errors,
+            participation_counts=dict(counts),
+            expected_per_peer=len(expected),
+            unexpected_shares=dict(unexpected),
+            inconsistent_pubkeys=inconsistent,
         )
         for sub in self._subs:
             res = sub(report)
@@ -180,9 +356,16 @@ def tracking(tracker: Tracker):
                 raise
             for step in steps:
                 tracker.step_event(duty, step)
+            if name == "fetcher.fetch" and args and hasattr(args[0], "keys"):
+                tracker.duty_scheduled(duty, args[0].keys())
             if name in ("parsigdb.store_external", "parsigdb.store_internal") and args:
-                for psig in args[0].values():
-                    tracker.partial_observed(duty, psig.share_idx)
+                for pubkey, psig in args[0].items():
+                    tracker.partial_observed(
+                        duty,
+                        psig.share_idx,
+                        pubkey=pubkey,
+                        root=_parsig_root(psig),
+                    )
             return result
 
         return wrapped

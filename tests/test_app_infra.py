@@ -176,6 +176,163 @@ def test_tracking_wire_option():
     asyncio.run(run())
 
 
+def test_tracker_inconsistent_parsigs():
+    """Same duty/pubkey with partials under DIFFERENT message roots is
+    reported and counted; threshold failures then carry the
+    bug_par_sig_db_inconsistent reason — except sync-message duties,
+    where disagreement is a known limitation
+    (ref: tracker.go:59-71 parsigsByMsg, reason.go:136,160)."""
+
+    async def run():
+        tr = Tracker(peer_share_indices=[1, 2, 3, 4])
+        duty = Duty(7, DutyType.ATTESTER)
+        pk = "0xaa"
+        for s in (
+            Step.SCHEDULER,
+            Step.FETCHER,
+            Step.CONSENSUS,
+            Step.DUTY_DB,
+            Step.VALIDATOR_API,
+            Step.PARSIG_DB_INTERNAL,
+            Step.PARSIG_EX,
+        ):
+            tr.step_event(duty, s)
+        tr.duty_scheduled(duty, [pk])
+        tr.partial_observed(duty, 1, pubkey=pk, root=b"r1" * 16)
+        tr.partial_observed(duty, 2, pubkey=pk, root=b"r2" * 16)  # mismatch!
+        tr.partial_observed(duty, 3, pubkey=pk, root=b"r1" * 16)
+        report = await tr.duty_expired(duty)
+        assert report.failed_step == Step.PARSIG_DB_THRESHOLD
+        assert report.reason == Reason.PARSIG_INCONSISTENT
+        assert report.inconsistent_pubkeys == [pk]
+        assert tr.inconsistent_total[DutyType.ATTESTER] == 1
+
+        # sync-message duties downgrade to the known-limitation reason
+        sduty = Duty(8, DutyType.SYNC_MESSAGE)
+        for s in (
+            Step.SCHEDULER,
+            Step.FETCHER,
+            Step.CONSENSUS,
+            Step.DUTY_DB,
+            Step.VALIDATOR_API,
+            Step.PARSIG_DB_INTERNAL,
+            Step.PARSIG_EX,
+        ):
+            tr.step_event(sduty, s)
+        tr.duty_scheduled(sduty, [pk])
+        tr.partial_observed(sduty, 1, pubkey=pk, root=b"x1" * 16)
+        tr.partial_observed(sduty, 2, pubkey=pk, root=b"x2" * 16)
+        sreport = await tr.duty_expired(sduty)
+        assert sreport.reason == Reason.PARSIG_INCONSISTENT_SYNC
+
+    asyncio.run(run())
+
+
+def test_tracker_unexpected_peer():
+    """A partial for a validator with NO scheduled definition counts as
+    unexpected-peer participation, not normal participation
+    (ref: tracker.go:539-573 analyseParticipation)."""
+
+    async def run():
+        tr = Tracker(peer_share_indices=[1, 2, 3, 4])
+        duty = Duty(9, DutyType.ATTESTER)
+        for s in Step:
+            tr.step_event(duty, s)
+        tr.duty_scheduled(duty, ["0xaa", "0xbb"])
+        tr.partial_observed(duty, 1, pubkey="0xaa", root=b"r" * 16)
+        tr.partial_observed(duty, 2, pubkey="0xbb", root=b"r" * 16)
+        # share 3 submits for a validator this cluster never scheduled
+        tr.partial_observed(duty, 3, pubkey="0xEVIL", root=b"r" * 16)
+        report = await tr.duty_expired(duty)
+        assert report.success
+        assert report.unexpected_shares == {3: 1}
+        assert tr.unexpected_total[3] == 1
+        assert report.participation_counts == {1: 1, 2: 1}
+        assert report.expected_per_peer == 2
+        assert report.participation[3] is False
+
+        # exit-style duties are never classified unexpected
+        eduty = Duty(9, DutyType.EXIT)
+        for s in Step:
+            tr.step_event(eduty, s)
+        tr.partial_observed(eduty, 3, pubkey="0xcc", root=b"r" * 16)
+        ereport = await tr.duty_expired(eduty)
+        assert ereport.unexpected_shares == {}
+
+    asyncio.run(run())
+
+
+def test_tracker_prerequisite_attribution():
+    """A proposer duty stuck at fetch when the slot's randao duty failed
+    is attributed to the randao failure
+    (ref: tracker.go analyseFetcherFailedProposer)."""
+
+    async def run():
+        tr = Tracker(peer_share_indices=[1, 2, 3, 4])
+        randao = Duty(11, DutyType.RANDAO)
+        tr.step_event(randao, Step.SCHEDULER)
+        tr.step_event(randao, Step.FETCHER)
+        rrep = await tr.duty_expired(randao)
+        assert not rrep.success
+
+        proposer = Duty(11, DutyType.PROPOSER)
+        tr.step_event(proposer, Step.SCHEDULER)  # fetch never completed
+        # the fetch RAISED (normal path: awaiting the randao aggregate
+        # fails) — prerequisite attribution still wins over the
+        # BN-error classification
+        tr.step_failed(proposer, Step.FETCHER, RuntimeError("agg timeout"))
+        prep = await tr.duty_expired(proposer)
+        assert prep.failed_step == Step.FETCHER
+        assert prep.reason == Reason.RANDAO_FAILED
+
+        # a plain attester fetch error (no prerequisite) is a BN error
+        att = Duty(12, DutyType.ATTESTER)
+        tr.step_event(att, Step.SCHEDULER)
+        tr.step_failed(att, Step.FETCHER, RuntimeError("http 500"))
+        arep = await tr.duty_expired(att)
+        assert arep.reason == Reason.FETCH_BN_ERROR
+        # and a silent fetch stall is the bug-class reason
+        att2 = Duty(13, DutyType.ATTESTER)
+        tr.step_event(att2, Step.SCHEDULER)
+        arep2 = await tr.duty_expired(att2)
+        assert arep2.reason == Reason.FETCH_FAILED
+
+    asyncio.run(run())
+
+
+def test_tracking_edge_collects_parsig_metadata():
+    """The wire option records scheduled pubkeys from fetcher.fetch and
+    (pubkey, share, root) triples from parsigdb stores."""
+
+    async def run():
+        from dataclasses import dataclass
+
+        tr = Tracker(peer_share_indices=[1, 2])
+        duty = Duty(6, DutyType.ATTESTER)
+
+        async def fetch(duty, defs):
+            return None
+
+        await tracking(tr)("fetcher.fetch", fetch)(duty, {"0xaa": object()})
+        assert tr._expected[duty] == {"0xaa"}
+
+        @dataclass
+        class FakePsig:
+            share_idx: int
+            data: object = None
+
+        async def store(duty, psigs):
+            return None
+
+        await tracking(tr)("parsigdb.store_external", store)(
+            duty, {"0xaa": FakePsig(2)}
+        )
+        roots = tr._parsigs[duty]["0xaa"]
+        assert len(roots) == 1 and 2 in next(iter(roots.values()))
+
+    asyncio.run(run())
+
+
 def test_forkjoin_bounded_order_and_failures():
     """ref: app/forkjoin/forkjoin.go — bounded fan-out, input order,
     per-input failure capture."""
