@@ -157,10 +157,12 @@ class Eth2HttpClient:
             dict(
                 pubkey=idx_to_pubkey[int(d["validator_index"])],
                 validator_index=int(d["validator_index"]),
-                subcommittee_index=int(
-                    d.get("validator_sync_committee_indices", [0])[0]
-                )
-                // 128,
+                # real committee positions; the scheduler derives the
+                # subcommittee (pos // 128) and in-subcommittee bit
+                sync_committee_indices=[
+                    int(p)
+                    for p in d.get("validator_sync_committee_indices", [0])
+                ],
             )
             for d in data
         ]

@@ -345,6 +345,12 @@ class ParSignedData:
     def message_root(self) -> bytes:
         """Root identifying *what* was signed — partials for the same duty
         group by this before threshold recombination
-        (ref: core/parsigdb/memory.go:198 groups by message root)."""
-        spec = SIGNED_KINDS[self.data.kind]
-        return spec.object_root(self.data.payload)
+        (ref: core/parsigdb/memory.go:198 groups by message root).
+        Cached: parsigdb grouping AND tracker consistency analysis hash
+        the same object on the store hot path."""
+        cached = getattr(self, "_root_cache", None)
+        if cached is None:
+            spec = SIGNED_KINDS[self.data.kind]
+            cached = spec.object_root(self.data.payload)
+            object.__setattr__(self, "_root_cache", cached)
+        return cached

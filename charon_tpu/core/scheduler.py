@@ -60,6 +60,12 @@ class DutyDefinition:
     committee_length: int = 1
     committees_at_slot: int = 1
     validator_committee_index: int = 0
+    # sync-committee duties: the validator's full set of committee
+    # positions (0..511). The workflow currently drives the FIRST
+    # position's subcommittee (committee_index/validator_committee_index
+    # derive from it); the rest are carried for forward-compat and the
+    # scheduler logs when a validator holds more than one seat.
+    sync_committee_positions: tuple = ()
 
 
 DutiesSub = Callable[[Duty, dict[PubKey, DutyDefinition]], Awaitable[None]]
@@ -212,10 +218,30 @@ class Scheduler:
                 epoch * self.slots_per_epoch, (epoch + 1) * self.slots_per_epoch
             ):
                 for sd in sync:
+                    # membership is a committee POSITION (0..511); the
+                    # subcommittee and the bit inside it derive from it
+                    # (spec duty shape: validator_sync_committee_indices)
+                    positions = [
+                        int(p)
+                        for p in sd.get("sync_committee_indices", [])
+                    ] or [int(sd.get("subcommittee_index", 0)) * 128]
+                    if len(positions) > 1 and slot % self.slots_per_epoch == 0:
+                        from charon_tpu.app import log
+
+                        log.warn(
+                            "validator holds multiple sync-committee "
+                            "seats; only the first position's "
+                            "subcommittee is driven",
+                            topic="sched",
+                            validator=sd["validator_index"],
+                            positions=positions,
+                        )
                     d = DutyDefinition(
                         pubkey=sd["pubkey"],
                         validator_index=sd["validator_index"],
-                        committee_index=sd.get("subcommittee_index", 0),
+                        committee_index=positions[0] // 128,
+                        validator_committee_index=positions[0] % 128,
+                        sync_committee_positions=tuple(positions),
                     )
                     out.setdefault(
                         Duty(slot, DutyType.SYNC_MESSAGE), {}

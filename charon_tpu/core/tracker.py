@@ -133,6 +133,16 @@ _UNSCHEDULED_TYPES = {
     DutyType.SIGNATURE,
 }
 
+# Terminal step per duty type: most duties end at broadcast, but the
+# internal aggregate-only duties (randao, the two selection-proof
+# prepares) complete at the aggregate store and never broadcast
+# (ref: tracker.go step expectations per duty type).
+_TERMINAL_STEPS = {
+    DutyType.RANDAO: Step.AGG_SIG_DB,
+    DutyType.PREPARE_AGGREGATOR: Step.AGG_SIG_DB,
+    DutyType.PREPARE_SYNC_CONTRIBUTION: Step.AGG_SIG_DB,
+}
+
 # Duties whose fetch depends on a prerequisite duty in the same slot
 # (ref: tracker.go analyseFetcherFailedProposer/-Aggregator/-SyncContribution).
 _FETCH_PREREQ = {
@@ -202,8 +212,11 @@ class Tracker:
         )
         # duty -> pubkeys with a locally scheduled definition
         self._expected: dict[Duty, set[PubKey]] = defaultdict(set)
-        # failure memory for prerequisite analysis (randao -> proposer)
+        # outcome memory for prerequisite analysis (randao -> proposer):
+        # expiry order within a slot is not guaranteed, so both failure
+        # AND success of already-analysed prerequisites are remembered
         self._failed_steps: dict[Duty, Step] = {}
+        self._completed: set[Duty] = set()
         self._subs: list[ReportSub] = []
         # counters (exported through app/metrics + monitoring endpoint)
         self.failed_total: dict[tuple, int] = defaultdict(int)
@@ -235,12 +248,31 @@ class Tracker:
 
     # -- analysis at duty expiry (ref: tracker.go:147-163) ----------------
 
+    def _prereq_failed(self, prereq: Duty) -> bool:
+        """Whether a prerequisite duty failed, robust to expiry ORDER:
+        duties in a slot share one deadline and the proposer can expire
+        before its randao — so when the prerequisite hasn't been analysed
+        yet, judge its LIVE event set (events are final by now: both
+        duties' deadlines have passed)."""
+        if prereq in self._completed:
+            return False
+        if prereq in self._failed_steps:
+            return True
+        steps = self._steps.get(prereq)
+        terminal = _TERMINAL_STEPS.get(prereq.type, Step.BCAST)
+        if steps is not None:
+            return terminal not in steps
+        # no events at all: the prerequisite never even started — that IS
+        # a prerequisite failure (ref: dutyFailedStep(empty) == failed)
+        return True
+
     async def duty_expired(self, duty: Duty) -> DutyReport:
         steps = self._steps.pop(duty, set())
         parsigs = self._parsigs.pop(duty, {})
         expected = self._expected.pop(duty, set())
         errors = self._errors.pop(duty, [])
-        success = Step.BCAST in steps
+        terminal = _TERMINAL_STEPS.get(duty.type, Step.BCAST)
+        success = terminal in steps
 
         # parsig consistency: more than one message root for one pubkey
         # (ref: parsigsByMsg.MsgRootsConsistent)
@@ -272,8 +304,11 @@ class Tracker:
         failed_step = None
         reason = None
         if not success:
-            # first pipeline step that never happened
+            # first pipeline step (up to this duty type's terminal step)
+            # that never happened
             for step in Step:
+                if step > terminal:
+                    break
                 if step not in steps:
                     failed_step = step
                     reason = _FAIL_REASONS.get(step, Reason.UNKNOWN)
@@ -304,8 +339,7 @@ class Tracker:
             # ref analyseFetcherFailedProposer
             if failed_step == Step.FETCHER and duty.type in _FETCH_PREREQ:
                 prereq_type, prereq_reason = _FETCH_PREREQ[duty.type]
-                prereq = Duty(duty.slot, prereq_type)
-                if self._failed_steps.get(prereq) is not None:
+                if self._prereq_failed(Duty(duty.slot, prereq_type)):
                     reason = prereq_reason
             self.failed_total[(duty.type, failed_step)] += 1
             self._failed_steps[duty] = failed_step
@@ -313,6 +347,10 @@ class Tracker:
             if len(self._failed_steps) > 1024:
                 for k in list(self._failed_steps)[:512]:
                     self._failed_steps.pop(k, None)
+        elif duty.type in {p for p, _ in _FETCH_PREREQ.values()}:
+            self._completed.add(duty)
+            if len(self._completed) > 1024:
+                self._completed = set(list(self._completed)[512:])
 
         part_map = {
             idx: idx in participation for idx in self.peer_share_indices

@@ -732,15 +732,22 @@ class VapiRouter:
         except (json.JSONDecodeError, ValueError, TypeError):
             want = set(self.validators.values())
         duties = await self.beacon.sync_duties(epoch, self.validators)
-        # sync_committee_index // 128 must equal the subcommittee_index the
-        # scheduler keys contributions on, or the VC's contribution query
-        # never matches the stored duty
+        # serve the validator's REAL committee position — the scheduler
+        # derives subcommittee (pos // 128) and in-subcommittee bit
+        # (pos % 128) from the same position. Served positions are
+        # limited to the FIRST (the one the scheduler drives) so the
+        # VC's contribution queries always match a stored duty; extra
+        # seats are a logged, documented limitation (scheduler.py).
         out = [
             {
                 "pubkey": d["pubkey"],
                 "validator_index": str(d["validator_index"]),
                 "validator_sync_committee_indices": [
-                    str(d.get("subcommittee_index", 0) * 128)
+                    str(int(p))
+                    for p in d.get(
+                        "sync_committee_indices",
+                        [d.get("subcommittee_index", 0) * 128],
+                    )[:1]
                 ],
             }
             for d in duties

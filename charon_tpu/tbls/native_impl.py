@@ -25,7 +25,17 @@ from charon_tpu.tbls import (
 )
 from charon_tpu.tbls.python_impl import PythonImpl, _check_len
 
-_LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libcharon_native.so"
+# CHARON_NATIVE_LIB overrides the shared object — the sanitized test
+# harness points it at libcharon_native_san.so (ASan/UBSan build) inside
+# an LD_PRELOAD=libasan subprocess (tests/test_native_sanitized.py).
+_LIB_PATH = Path(
+    os.environ.get(
+        "CHARON_NATIVE_LIB",
+        Path(__file__).resolve().parent.parent.parent
+        / "native"
+        / "libcharon_native.so",
+    )
+)
 
 
 def _load():

@@ -99,15 +99,25 @@ class BeaconMock:
             out.append(dict(slot=slot, pubkey=pubkey, validator_index=vidx))
         return out
 
+    def sync_committee_position(self, vidx: int) -> int:
+        """Deterministic position of a validator in the 512-member sync
+        committee. Multiplying by an odd constant mod 512 is a bijection,
+        so positions (and hence subcommittees AND positions WITHIN a
+        subcommittee) spread non-trivially — a test that conflates
+        position, subcommittee, or in-subcommittee index will fail."""
+        return (vidx * 131 + 7) % 512
+
     async def sync_duties(self, epoch: int, validators: dict[PubKey, int]):
-        """Every validator is a sync-committee member (deterministic);
-        subcommittee = validator index mod 4 (ref: beaconmock
-        WithDeterministicSyncCommDuties)."""
+        """Every validator is a sync-committee member (deterministic)
+        with a REAL committee position; the spec duty shape carries the
+        positions (`validator_sync_committee_indices`), everything else
+        (subcommittee = pos // 128, bit = pos % 128) is derived from
+        them (ref: beaconmock WithDeterministicSyncCommDuties)."""
         return [
             dict(
                 pubkey=pubkey,
                 validator_index=vidx,
-                subcommittee_index=vidx % 4,
+                sync_committee_indices=[self.sync_committee_position(vidx)],
             )
             for pubkey, vidx in sorted(validators.items())
         ]
@@ -193,13 +203,22 @@ class BeaconMock:
         return self._root("block", slot)
 
     async def sync_contribution(self, slot: int, subcommittee_index: int, block_root: bytes):
+        """The aggregation bits are the TRUE membership bits: position %
+        128 for every registered validator whose committee position lands
+        in this subcommittee (a real BN sets the bits of the messages it
+        aggregated; the mock assumes every member's message arrived)."""
         from charon_tpu.core.eth2data import SyncCommitteeContribution
 
+        bits = [False] * 128
+        for vidx in self.validators.values():
+            pos = self.sync_committee_position(vidx)
+            if pos // 128 == subcommittee_index:
+                bits[pos % 128] = True
         return SyncCommitteeContribution(
             slot=slot,
             beacon_block_root=block_root,
             subcommittee_index=subcommittee_index,
-            aggregation_bits=tuple(i < 2 for i in range(128)),
+            aggregation_bits=tuple(bits),
         )
 
     # -- chain/inclusion queries (ref: inclusion checker's BN surface) ----

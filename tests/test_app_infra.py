@@ -285,6 +285,34 @@ def test_tracker_prerequisite_attribution():
         assert prep.failed_step == Step.FETCHER
         assert prep.reason == Reason.RANDAO_FAILED
 
+        # expiry ORDER must not matter: the proposer often expires BEFORE
+        # its randao (same deadline, Duty ordering ties) — the live event
+        # set of the un-analysed randao is judged instead
+        randao2 = Duty(20, DutyType.RANDAO)
+        tr.step_event(randao2, Step.SCHEDULER)  # stuck at fetch, unexpired
+        prop2 = Duty(20, DutyType.PROPOSER)
+        tr.step_event(prop2, Step.SCHEDULER)
+        tr.step_failed(prop2, Step.FETCHER, RuntimeError("agg timeout"))
+        prep2 = await tr.duty_expired(prop2)  # proposer analysed first
+        assert prep2.reason == Reason.RANDAO_FAILED
+
+        # ...and a SUCCESSFUL live randao (terminal = aggregate store,
+        # randao never broadcasts) must NOT be blamed
+        randao3 = Duty(21, DutyType.RANDAO)
+        for s in Step:
+            if s <= Step.AGG_SIG_DB:
+                tr.step_event(randao3, s)
+        prop3 = Duty(21, DutyType.PROPOSER)
+        tr.step_event(prop3, Step.SCHEDULER)
+        tr.step_failed(prop3, Step.FETCHER, RuntimeError("http 500"))
+        prep3 = await tr.duty_expired(prop3)
+        assert prep3.reason == Reason.FETCH_BN_ERROR
+        # and when that randao expires it is reported SUCCESSFUL
+        rrep3 = await tr.duty_expired(randao3)
+        assert rrep3.success
+        # success memory: a later same-slot proposer check still clears it
+        assert not tr._prereq_failed(randao3)
+
         # a plain attester fetch error (no prerequisite) is a BN error
         att = Duty(12, DutyType.ATTESTER)
         tr.step_event(att, Step.SCHEDULER)

@@ -51,3 +51,64 @@ def test_fuzz_randomizes_attestation_data_and_injects_errors():
             assert d.hash_tree_root()
 
     asyncio.run(main())
+
+
+def test_sync_committee_membership_is_positional():
+    """Membership indices are REAL committee positions (0..511) flowing
+    from the beacon's assignment end-to-end: duty JSON carries them, the
+    scheduler derives subcommittee (pos // 128) and the in-subcommittee
+    bit (pos % 128), and the mock BN's contribution sets exactly the
+    member bits of that subcommittee — nothing is fabricated as
+    subcommittee_index * 128."""
+    from charon_tpu.core.types import pubkey_from_bytes
+    from charon_tpu.testutil.beaconmock import BeaconMock
+
+    async def main():
+        validators = {
+            pubkey_from_bytes(bytes([i + 1]) * 48): i for i in range(6)
+        }
+        mock = BeaconMock(validators=validators)
+        duties = await mock.sync_duties(0, validators)
+        positions = {
+            d["validator_index"]: d["sync_committee_indices"][0]
+            for d in duties
+        }
+        # bijective spread: distinct positions, not multiples of 128
+        assert len(set(positions.values())) == len(positions)
+        assert any(p % 128 != 0 for p in positions.values())
+        assert all(0 <= p < 512 for p in positions.values())
+
+        # contribution bits match exactly the members of the subcommittee
+        for sub in range(4):
+            contrib = await mock.sync_contribution(5, sub, b"\x00" * 32)
+            want = {
+                pos % 128
+                for pos in positions.values()
+                if pos // 128 == sub
+            }
+            got = {i for i, b in enumerate(contrib.aggregation_bits) if b}
+            assert got == want, (sub, got, want)
+
+    asyncio.run(main())
+
+
+def test_scheduler_derives_sync_coordinates_from_positions():
+    from charon_tpu.core.scheduler import Scheduler
+    from charon_tpu.core.types import Duty, DutyType, pubkey_from_bytes
+    from charon_tpu.testutil.beaconmock import BeaconMock
+
+    async def main():
+        validators = {
+            pubkey_from_bytes(bytes([i + 1]) * 48): i for i in range(3)
+        }
+        mock = BeaconMock(validators=validators, slots_per_epoch=4)
+        sched = Scheduler(mock, mock.clock(), validators, slots_per_epoch=4)
+        await sched._resolve_epoch(0)
+        defs = sched._defs[0][Duty(0, DutyType.SYNC_MESSAGE)]
+        for pk, vidx in validators.items():
+            pos = mock.sync_committee_position(vidx)
+            d = defs[pk]
+            assert d.committee_index == pos // 128
+            assert d.validator_committee_index == pos % 128
+
+    asyncio.run(main())

@@ -1,0 +1,111 @@
+"""ASan/UBSan run of the native C++ backend.
+
+The backend handles secret key shares in sign(), so memory errors are
+security bugs. This mirrors the reference's sanitizer discipline (`-race`
+on every CI tier, ref: .github/workflows/test.yml:21,44,72): build the
+`native/libcharon_native_san.so` target and drive the cross-impl
+operations (keygen, split/recover, sign, verify, threshold aggregate,
+malformed inputs) inside an LD_PRELOAD=libasan subprocess —
+`halt_on_error` makes any finding a hard failure.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+
+_DRIVER = r"""
+import os
+from charon_tpu.tbls.native_impl import NativeImpl
+from charon_tpu.tbls import TblsError
+
+impl = NativeImpl()
+sk = impl.generate_secret_key()
+pk = impl.secret_to_public_key(sk)
+msg = b"sanitized cross-impl message"
+sig = impl.sign(sk, msg)
+impl.verify(pk, msg, sig)
+
+# threshold ceremony
+shares = impl.threshold_split(sk, 4, 3)
+rec = impl.recover_secret(dict(list(shares.items())[:3]), 4, 3)
+assert rec == sk
+partials = {i: impl.sign(s, msg) for i, s in list(shares.items())[:3]}
+group = impl.threshold_aggregate(partials)
+impl.verify(pk, msg, group)
+
+# aggregates + batch
+agg = impl.aggregate([sig, sig])
+assert impl.verify_batch([(pk, msg, sig)]) == [True]
+
+# malformed / adversarial inputs must error, not scribble
+for bad in (b"", b"\x00" * 96, b"\xff" * 96, sig[:-1] + bytes([sig[-1] ^ 1])):
+    try:
+        impl.verify(pk, msg, bad)
+        assert len(bad) == 96, "short sig accepted"
+        raise SystemExit("forged signature verified")
+    except TblsError:
+        pass
+for badpk in (b"", b"\x00" * 48, b"\xff" * 48):
+    try:
+        impl.verify(badpk, msg, sig)
+        raise SystemExit("bad pubkey accepted")
+    except TblsError:
+        pass
+impl.hash_to_g2_bytes(b"x" * 1000)
+print("SAN-DRIVE-OK")
+"""
+
+
+def _libasan() -> str | None:
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out if out and os.path.sep in out and Path(out).exists() else None
+
+
+def test_native_backend_under_asan_ubsan():
+    libasan = _libasan()
+    if libasan is None:
+        pytest.skip("libasan not available")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "asan"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert build.returncode == 0, f"asan build failed:\n{build.stderr[-2000:]}"
+
+    env = dict(os.environ)
+    env.update(
+        LD_PRELOAD=libasan,
+        CHARON_NATIVE_LIB=str(NATIVE / "libcharon_native_san.so"),
+        ASAN_OPTIONS="halt_on_error=1:detect_leaks=0",
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+        PYTHONPATH=str(REPO) + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVER],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0 and "SAN-DRIVE-OK" in proc.stdout, (
+        f"sanitized run failed (rc={proc.returncode}):\n"
+        f"stdout: {proc.stdout[-1000:]}\nstderr: {proc.stderr[-3000:]}"
+    )
