@@ -42,17 +42,47 @@ _METHODS = (
 
 
 class MultiClient:
-    """Try each client in order; first success wins. The best (lowest
-    error count) client is promoted to primary (ref: multi.go picks the
-    best client adaptively)."""
+    """Try each client in best-first order; first success wins.
+
+    Best-client selection (ref: multi.go picks the best client
+    adaptively): clients are ordered by recent ERROR count first, then
+    by rolling median LATENCY — a healthy-but-slow fallback BN stops
+    being primary as soon as the fast one recovers, and duty-critical
+    calls (attestation data at ⅓ slot) ride the fastest healthy node."""
+
+    LATENCY_WINDOW = 64
 
     def __init__(self, clients: Sequence[Any], timeout: float = 5.0) -> None:
+        from collections import deque
+
         if not clients:
             raise ValueError("need at least one beacon client")
         self.clients = list(clients)
         self.timeout = timeout
         self.latencies: dict[str, list[float]] = defaultdict(list)
         self.errors: dict[int, int] = defaultdict(int)
+        # rolling per-client latency window for the selection heuristic
+        self.client_latency: dict[int, Any] = {
+            i: deque(maxlen=self.LATENCY_WINDOW)
+            for i in range(len(self.clients))
+        }
+
+    def _median_latency(self, i: int) -> float:
+        import statistics
+
+        window = self.client_latency[i]
+        # untried clients get a chance at the front
+        return statistics.median_high(window) if window else 0.0
+
+    def best_order(self) -> list[int]:
+        return sorted(
+            range(len(self.clients)),
+            key=lambda i: (self.errors[i], self._median_latency(i)),
+        )
+
+    @property
+    def best_idx(self) -> int:
+        return self.best_order()[0]
 
     def __getattr__(self, name: str):
         if name not in _METHODS:
@@ -60,18 +90,16 @@ class MultiClient:
 
         async def call(*args, **kwargs):
             errs = []
-            # order clients by recent error count (stable for ties)
-            order = sorted(
-                range(len(self.clients)), key=lambda i: self.errors[i]
-            )
-            for i in order:
+            for i in self.best_order():
                 client = self.clients[i]
                 t0 = time.monotonic()
                 try:
                     result = await asyncio.wait_for(
                         getattr(client, name)(*args, **kwargs), self.timeout
                     )
-                    self.latencies[name].append(time.monotonic() - t0)
+                    elapsed = time.monotonic() - t0
+                    self.latencies[name].append(elapsed)
+                    self.client_latency[i].append(elapsed)
                     self.errors[i] = max(0, self.errors[i] - 1)
                     return result
                 except Exception as e:  # noqa: BLE001 — any failure fails over

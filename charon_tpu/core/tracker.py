@@ -156,6 +156,7 @@ _FETCH_PREREQ = {
         Reason.PREPARE_SYNC_CONTRIBUTION_FAILED,
     ),
 }
+_PREREQ_TYPES = frozenset(p for p, _ in _FETCH_PREREQ.values())
 
 
 @dataclass
@@ -216,7 +217,7 @@ class Tracker:
         # expiry order within a slot is not guaranteed, so both failure
         # AND success of already-analysed prerequisites are remembered
         self._failed_steps: dict[Duty, Step] = {}
-        self._completed: set[Duty] = set()
+        self._completed: dict[Duty, None] = {}  # insertion-ordered set
         self._subs: list[ReportSub] = []
         # counters (exported through app/metrics + monitoring endpoint)
         self.failed_total: dict[tuple, int] = defaultdict(int)
@@ -347,10 +348,12 @@ class Tracker:
             if len(self._failed_steps) > 1024:
                 for k in list(self._failed_steps)[:512]:
                     self._failed_steps.pop(k, None)
-        elif duty.type in {p for p, _ in _FETCH_PREREQ.values()}:
-            self._completed.add(duty)
+        elif duty.type in _PREREQ_TYPES:
+            self._completed[duty] = None
+            # FIFO eviction, mirroring _failed_steps above
             if len(self._completed) > 1024:
-                self._completed = set(list(self._completed)[512:])
+                for k in list(self._completed)[:512]:
+                    self._completed.pop(k, None)
 
         part_map = {
             idx: idx in participation for idx in self.peer_share_indices

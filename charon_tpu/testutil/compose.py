@@ -130,23 +130,39 @@ class ComposeCluster:
         self.env.update(env or {})
 
     def start(self) -> None:
+        for node in self.config["nodes"]:
+            self.procs.append(self._spawn(node, mode="w"))
+
+    def _spawn(self, node: dict, mode: str = "a") -> subprocess.Popen:
         # per-node log files, NOT pipes: an undrained pipe blocks a chatty
         # node once the OS buffer fills and stalls the whole cluster
-        for node in self.config["nodes"]:
-            log_path = Path(node["data_dir"]) / "node.log"
-            node["log_path"] = str(log_path)
-            log_file = open(log_path, "w")
-            self.procs.append(
-                subprocess.Popen(
-                    node["argv"],
-                    env=self.env,
-                    cwd=str(REPO),
-                    stdout=log_file,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                )
-            )
-            log_file.close()  # child holds its own fd
+        log_path = Path(node["data_dir"]) / "node.log"
+        node["log_path"] = str(log_path)
+        log_file = open(log_path, mode)
+        proc = subprocess.Popen(
+            node["argv"],
+            env=self.env,
+            cwd=str(REPO),
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        log_file.close()  # child holds its own fd
+        return proc
+
+    def kill_node(self, i: int) -> None:
+        """CRASH node i (SIGKILL — no graceful shutdown, mirroring the
+        crash-only recovery story: durable state is only what's on disk).
+        The node is excluded from liveness checks until restarted."""
+        self.procs[i].kill()
+        self.procs[i].wait()
+
+    def restart_node(self, i: int) -> None:
+        """Relaunch a killed node with its original command line — it
+        must recover purely from its on-disk state (keystores, lock) and
+        the shared genesis-time clock."""
+        assert self.procs[i].poll() is not None, f"node {i} still running"
+        self.procs[i] = self._spawn(self.config["nodes"][i])
 
     def metrics(self, i: int) -> str:
         port = self.config["nodes"][i]["monitoring_port"]
@@ -165,20 +181,25 @@ class ComposeCluster:
         return total if found else 0.0
 
     def wait_metric(
-        self, name: str, minimum: float, timeout: float = 60.0
+        self,
+        name: str,
+        minimum: float,
+        timeout: float = 60.0,
+        nodes: list[int] | None = None,
     ) -> None:
-        """Block until every node's `name` metric reaches `minimum`."""
+        """Block until each listed node's `name` metric reaches
+        `minimum` (all nodes when `nodes` is None)."""
+        idxs = (
+            list(range(len(self.config["nodes"]))) if nodes is None else nodes
+        )
         deadline = time.time() + timeout
         while time.time() < deadline:
             try:
-                if all(
-                    self.metric_value(i, name) >= minimum
-                    for i in range(len(self.config["nodes"]))
-                ):
+                if all(self.metric_value(i, name) >= minimum for i in idxs):
                     return
             except Exception:
                 pass  # node still starting
-            self._check_alive()
+            self._check_alive(idxs)
             time.sleep(0.5)
         raise TimeoutError(f"metric {name} never reached {minimum}")
 
@@ -188,11 +209,14 @@ class ComposeCluster:
         except OSError:
             return ""
 
-    def _check_alive(self) -> None:
-        for i, p in enumerate(self.procs):
-            if p.poll() is not None:
+    def _check_alive(self, nodes: list[int] | None = None) -> None:
+        idxs = (
+            list(range(len(self.procs))) if nodes is None else nodes
+        )
+        for i in idxs:
+            if self.procs[i].poll() is not None:
                 raise RuntimeError(
-                    f"node {i} exited rc={p.returncode}:\n"
+                    f"node {i} exited rc={self.procs[i].returncode}:\n"
                     f"{self.node_log(i)[-4000:]}"
                 )
 

@@ -281,3 +281,41 @@ class HttpVapiClient:
     async def node_version(self) -> str:
         j = await self._get("/eth/v1/node/version")
         return j["data"]["version"]
+
+
+class SchemaCheckedVapiClient(HttpVapiClient):
+    """HttpVapiClient that asserts every request body and response
+    against the published beacon-API OpenAPI shapes
+    (testutil/schemas.py). A violation raises SchemaError mid-duty, so
+    any flow completed under this client is schema-conformant — the
+    in-repo stand-in for the reference's real-VC integration tier
+    (ref: testutil/integration runs Teku against charon's vapi)."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.checked = 0
+        self.unmatched: list[tuple[str, str]] = []
+
+    def _check(self, method: str, path: str, req, resp) -> None:
+        from charon_tpu.testutil import schemas
+
+        route = schemas.find_route(method, path)
+        if route is None:
+            self.unmatched.append((method, path))
+            return
+        req_schema, resp_schema = route
+        if req_schema is not None and req is not None:
+            schemas.validate(req_schema, req, f"{method} {path} request")
+        if resp_schema is not None:
+            schemas.validate(resp_schema, resp, f"{method} {path} response")
+        self.checked += 1
+
+    async def _get(self, path: str, params=None) -> dict:
+        j = await super()._get(path, params)
+        self._check("GET", path, None, j)
+        return j
+
+    async def _post(self, path: str, payload, headers=None):
+        j = await super()._post(path, payload, headers)
+        self._check("POST", path, payload, j)
+        return j

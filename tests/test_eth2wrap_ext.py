@@ -186,3 +186,39 @@ def test_expbackoff_first_sleep_is_base_delay():
 
     asyncio.run(main())
     assert slept == [1.0, 2.0]
+
+
+def test_multiclient_prefers_lower_latency_when_errors_tie():
+    """Best-client selection: equal error counts order by rolling median
+    latency, so a slow-but-healthy fallback yields primary back to the
+    fast BN (ref: multi.go adaptive best-client pick)."""
+    from charon_tpu.app.eth2wrap import MultiClient
+
+    class TimedClient:
+        def __init__(self, delay):
+            self.delay = delay
+            self.calls = 0
+
+        async def attestation_data(self, slot, committee):
+            self.calls += 1
+            await asyncio.sleep(self.delay)
+            return {"slot": slot}
+
+    slow, fast = TimedClient(0.05), TimedClient(0.0)
+    mc = MultiClient([slow, fast])
+
+    async def main():
+        # seed both windows: untried clients sort first, so the first
+        # call hits slow (idx 0), then force one call through fast
+        await mc.attestation_data(1, 0)
+        mc.errors[0] += 1  # fail over once so fast gets sampled
+        await mc.attestation_data(1, 0)
+        mc.errors[0] -= 1
+        assert fast.calls == 1
+        # errors now tie at 0: latency decides — fast must be primary
+        assert mc.best_idx == 1
+        before = fast.calls
+        await mc.attestation_data(1, 0)
+        assert fast.calls == before + 1 and slow.calls == 1
+
+    asyncio.run(main())

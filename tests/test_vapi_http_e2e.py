@@ -37,7 +37,7 @@ def host_tbls():
     tbls.set_implementation(PythonImpl())
 
 
-async def _start_http(cluster):
+async def _start_http(cluster, client_cls=HttpVapiClient):
     """One router + HTTP client + HTTP vmock per node."""
     routers, clients, vmocks = [], [], []
     validators = {pk: i for i, pk in enumerate(cluster.group_pubkeys)}
@@ -51,7 +51,7 @@ async def _start_http(cluster):
             slot_duration=cluster.beacon.slot_duration,
         )
         port = await router.start()
-        client = HttpVapiClient(f"http://127.0.0.1:{port}", validators)
+        client = client_cls(f"http://127.0.0.1:{port}", validators)
         vmock = HttpValidatorMock(
             client=client,
             share_keys=cluster.share_keys[node.share_idx - 1],
