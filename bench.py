@@ -63,38 +63,10 @@ def hb(msg: str) -> None:
 
 
 def main() -> None:
-    import threading
+    from bench_common import init_jax_with_watchdog
 
-    # Watchdog: a wedged device tunnel hangs jax backend init forever
-    # (observed: jax.devices() blocking >1h after a chip-lease hiccup).
-    # The driver must ALWAYS get one parseable line, so if init doesn't
-    # finish in time we print the error JSON and hard-exit.
-    init_done = threading.Event()
-
-    def _watchdog():
-        if not init_done.wait(timeout=300):
-            print(
-                json.dumps(
-                    {
-                        "metric": "batched_bls_verify",
-                        "value": 0.0,
-                        "unit": "sigs/sec",
-                        "vs_baseline": 0.0,
-                        "error": "device init watchdog: backend claim hung >300s (tunnel wedged)",
-                    }
-                ),
-                flush=True,
-            )
-            os._exit(0)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
-
-    import jax
-
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax = init_jax_with_watchdog("batched_bls_verify", "sigs/sec")
     hb(f"jax up, devices={jax.devices()}")
-    init_done.set()
 
     from charon_tpu.crypto import h2c
     from charon_tpu.crypto.g1g2 import g1_from_bytes, g2_from_bytes
@@ -161,31 +133,43 @@ def main() -> None:
             )
         )
 
-    state = {"kernel": make_kernel(), "fallback": False}
+    # degradation ladder: fused-fp2 pallas -> plain mont pallas -> pure
+    # XLA. Each rung re-jits once; a Mosaic regression in the newest
+    # kernel family only costs its own speedup, not the whole fast path.
+    from charon_tpu.ops import fptower as FT
+
+    def _rung_fp2_off():
+        FT.set_fp2_fusion(False)
+
+    def _rung_pallas_off():
+        limb.set_pallas(False)
+
+    state = {"kernel": make_kernel(), "rungs": [
+        ("without fp2 fusion", _rung_fp2_off),
+        ("without pallas", _rung_pallas_off),
+    ]}
 
     def run_verify(args, label: str):
-        """Run the kernel; on the FIRST failure disable the Pallas fast
-        path and retry once on the pure-XLA engine; re-raise after that
-        so the caller can fall through to a smaller batch."""
-        try:
-            t = time.perf_counter()
-            ok = state["kernel"](*args)
-            ok.block_until_ready()
-            hb(f"{label} compile+run {time.perf_counter() - t:.1f}s")
-        except Exception as e:
-            if state["fallback"]:
-                raise
-            hb(
-                f"{label} failed ({type(e).__name__}: {str(e)[:120]}); "
-                "retrying without pallas"
-            )
-            limb.set_pallas(False)
-            state["fallback"] = True
-            state["kernel"] = make_kernel()
-            t = time.perf_counter()
-            ok = state["kernel"](*args)
-            ok.block_until_ready()
-            hb(f"{label} fallback compile+run {time.perf_counter() - t:.1f}s")
+        """Run the kernel; on failure step down the degradation ladder
+        and retry; re-raise once out of rungs so the caller can fall
+        through to a smaller batch."""
+        while True:
+            try:
+                t = time.perf_counter()
+                ok = state["kernel"](*args)
+                ok.block_until_ready()
+                hb(f"{label} compile+run {time.perf_counter() - t:.1f}s")
+                break
+            except Exception as e:
+                if not state["rungs"]:
+                    raise
+                rung_name, apply = state["rungs"].pop(0)
+                hb(
+                    f"{label} failed ({type(e).__name__}: {str(e)[:120]}); "
+                    f"retrying {rung_name}"
+                )
+                apply()
+                state["kernel"] = make_kernel()
         assert bool(ok), f"{label} batch verification failed"
         return ok
 

@@ -1,0 +1,53 @@
+"""Shared device-init plumbing for the repo-root benchmarks.
+
+One watchdog contract for bench.py and bench_slotstep.py: the driver
+must ALWAYS get one parseable JSON line, even when a wedged axon tunnel
+hangs the backend claim forever (observed: jax.devices() blocking >1h
+after a chip-lease hiccup). Also pins the platform back to CPU for
+explicit smoke runs — the image's TPU plugin sitecustomize sets
+jax_platforms="axon,cpu" at CONFIG level, overriding the env var.
+"""
+
+from __future__ import annotations
+
+
+def init_jax_with_watchdog(metric: str, unit: str, timeout: float = 300.0):
+    """Import jax, claim the backend under a watchdog, set the persistent
+    compile cache. Returns the jax module; on a hung claim prints the
+    error JSON line and hard-exits 0."""
+    import json
+    import os
+    import threading
+
+    init_done = threading.Event()
+
+    def _watchdog():
+        if not init_done.wait(timeout=timeout):
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": 0.0,
+                        "unit": unit,
+                        "vs_baseline": 0.0,
+                        "error": (
+                            "device init watchdog: backend claim hung "
+                            f">{int(timeout)}s (tunnel wedged)"
+                        ),
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.devices()  # force the backend claim while the watchdog is armed
+    init_done.set()
+    return jax

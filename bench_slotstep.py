@@ -27,10 +27,9 @@ def hb(msg: str) -> None:
 
 
 def main() -> None:
-    import jax
+    from bench_common import init_jax_with_watchdog
 
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax = init_jax_with_watchdog("slot_step", "validators/sec")
     hb(f"devices={jax.devices()}")
 
     from charon_tpu.crypto import h2c
@@ -41,10 +40,14 @@ def main() -> None:
     if len(sys.argv) > 1:
         raw = list(zip(sys.argv[1::2], sys.argv[2::2]))
     else:
+        # defaults are the BASELINE.json workload shapes: config 2
+        # (1k-validator attestation duty, 4-of-7) and config 3
+        # (sync contribution, 512 validators x 7 partials); the 100k
+        # mega-operator (config 5) extrapolates from the largest
         raw = [
             pair.split(":")
             for pair in os.environ.get(
-                "SLOTSTEP_CONFIGS", "64:4 256:4"
+                "SLOTSTEP_CONFIGS", "256:4 512:7 1024:4"
             ).split()
         ]
     configs = [(int(v), int(t)) for v, t in raw]

@@ -222,7 +222,7 @@ def fp2_batch(ctx, ops):
     normalize and the base multiply, which is where the engine was
     measured HBM-bound (PERF.md).
     """
-    if limb._pallas_active(ctx):
+    if _FP2_FUSION and limb._pallas_active(ctx):
         return _fp2_batch_pallas(ctx, ops)
     # prep level: every Karatsuba sum / squaring sum+difference in ONE
     # stacked normalize
@@ -298,6 +298,19 @@ def fp2_batch(ctx, ops):
             out.append((prods[i], prods[i + 1]))
             i += 2
     return out
+
+
+# Fused-Fp2 escape hatch: disabling fusion keeps the (independently
+# proven) mont_mul Pallas kernel active while the fp2 ops fall back to
+# the stacked-XLA path — bench.py's degradation ladder uses this so a
+# Mosaic regression in the fused kernels costs ~2x, not the ~10x of
+# losing Pallas entirely.
+_FP2_FUSION = True
+
+
+def set_fp2_fusion(mode: bool) -> None:
+    global _FP2_FUSION
+    _FP2_FUSION = mode
 
 
 def _fp2_batch_pallas(ctx, ops):

@@ -122,6 +122,7 @@ class ComposeCluster:
     def __init__(self, config: dict, env: dict | None = None):
         self.config = config
         self.procs: list[subprocess.Popen] = []
+        self._killed: set[int] = set()
         self.env = dict(os.environ)
         self.env["JAX_PLATFORMS"] = "cpu"
         self.env["PYTHONPATH"] = (
@@ -156,6 +157,7 @@ class ComposeCluster:
         The node is excluded from liveness checks until restarted."""
         self.procs[i].kill()
         self.procs[i].wait()
+        self._killed.add(i)
 
     def restart_node(self, i: int) -> None:
         """Relaunch a killed node with its original command line — it
@@ -163,6 +165,7 @@ class ComposeCluster:
         the shared genesis-time clock."""
         assert self.procs[i].poll() is not None, f"node {i} still running"
         self.procs[i] = self._spawn(self.config["nodes"][i])
+        self._killed.discard(i)
 
     def metrics(self, i: int) -> str:
         port = self.config["nodes"][i]["monitoring_port"]
@@ -190,7 +193,9 @@ class ComposeCluster:
         """Block until each listed node's `name` metric reaches
         `minimum` (all nodes when `nodes` is None)."""
         idxs = (
-            list(range(len(self.config["nodes"]))) if nodes is None else nodes
+            [i for i in range(len(self.config["nodes"])) if i not in self._killed]
+            if nodes is None
+            else nodes
         )
         deadline = time.time() + timeout
         while time.time() < deadline:
@@ -211,7 +216,9 @@ class ComposeCluster:
 
     def _check_alive(self, nodes: list[int] | None = None) -> None:
         idxs = (
-            list(range(len(self.procs))) if nodes is None else nodes
+            [i for i in range(len(self.procs)) if i not in self._killed]
+            if nodes is None
+            else nodes
         )
         for i in idxs:
             if self.procs[i].poll() is not None:

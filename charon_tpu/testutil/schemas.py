@@ -39,10 +39,12 @@ def Uint(where: str, v: Any) -> None:
 
 def Hex(length: int | None = None) -> Callable:
     def check(where: str, v: Any) -> None:
+        # whole bytes only: odd nibble counts are not decodable and a
+        # real VC's hex parser rejects them
         if not isinstance(v, str) or not re.fullmatch(
-            r"0x[0-9a-fA-F]*", v
+            r"0x(?:[0-9a-fA-F]{2})*", v
         ):
-            _fail(where, f"expected 0x-hex string, got {v!r}")
+            _fail(where, f"expected 0x-hex string (whole bytes), got {v!r}")
         if length is not None and len(v) != 2 + 2 * length:
             _fail(where, f"expected {length}-byte hex, got {len(v) // 2 - 1}")
 
@@ -429,13 +431,21 @@ ROUTES: list[tuple[str, str, Callable | None, Callable | None]] = [
         "POST",
         r"/eth/v1/validator/duties/attester/\d+",
         Arr(Uint),
-        Data(Arr(ATTESTER_DUTY), optional=("dependent_root",)),
+        Data(
+            Arr(ATTESTER_DUTY),
+            extra={"dependent_root": Hex(32)},
+            optional=("dependent_root",),
+        ),
     ),
     (
         "GET",
         r"/eth/v1/validator/duties/proposer/\d+",
         None,
-        Data(Arr(PROPOSER_DUTY), optional=("dependent_root",)),
+        Data(
+            Arr(PROPOSER_DUTY),
+            extra={"dependent_root": Hex(32)},
+            optional=("dependent_root",),
+        ),
     ),
     (
         "POST",

@@ -90,6 +90,45 @@ def test_fp2_multi_tile_batch():
     )
 
 
+def test_fp2_fusion_flag_routes_fp2_batch():
+    """set_fp2_fusion toggles fp2_batch between the fused-kernel route
+    and the stacked-XLA route while pallas stays active — bench.py's
+    middle degradation rung. The routing check is observed directly; the
+    first _pallas_active probe (the route decision) reports active, the
+    inner limb ops see inactive so the XLA body runs on CPU."""
+    rng = random.Random(37)
+    a, b = _rand_fp2(rng, 4), _rand_fp2(rng, 4)
+    sentinel = [("fused", "fused")]
+
+    probes = {"n": 0}
+
+    def first_probe_active(ctx):
+        probes["n"] += 1
+        return probes["n"] == 1
+
+    # fusion ON: the fused route is taken
+    with mock.patch.object(limb, "_pallas_active", first_probe_active):
+        with mock.patch.object(
+            T, "_fp2_batch_pallas", return_value=sentinel
+        ) as fused:
+            assert T.fp2_batch(CTX, [("mul", a, b)]) == sentinel
+            assert fused.called
+
+    # fusion OFF: the route short-circuits before probing pallas and the
+    # XLA body runs (fused path would raise if taken)
+    try:
+        T.set_fp2_fusion(False)
+        with mock.patch.object(
+            T, "_fp2_batch_pallas", side_effect=AssertionError("fused")
+        ):
+            (got,) = T.fp2_batch(CTX, [("mul", a, b)])
+    finally:
+        T.set_fp2_fusion(True)
+    want = T.fp2_mul(CTX, a, b)  # pallas fully off here
+    for i in range(2):
+        assert np.array_equal(np.asarray(got[i]), np.asarray(want[i]))
+
+
 def test_fp2_batch_pallas_dispatch_matches_xla():
     """The fp2_batch pallas route (stacked mul/sqr/mul_fp) must return
     exactly what the XLA route returns, op for op."""
