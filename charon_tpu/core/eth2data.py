@@ -130,6 +130,57 @@ def signed_proposal_json(p: Proposal, signature: bytes) -> dict:
     }
 
 
+def proposal_data_ssz(p: Proposal) -> bytes:
+    """SSZ wire body for the produceBlockV3 `data` payload (served when
+    the VC sends Accept: application/octet-stream — Lighthouse-style
+    clients prefer SSZ for blocks)."""
+    if p.blinded or p.version not in FORKS_WITH_CONTENTS:
+        return ssz.serialize(p.block)
+    return ssz.serialize(
+        _spec.BlockContentsDeneb(p.block, p.kzg_proofs, p.blobs)
+    )
+
+
+def signed_proposal_ssz(p: Proposal, signature: bytes) -> bytes:
+    """SSZ wire body for publishBlock/publishBlindedBlock."""
+    full_cls, blind_cls = _spec.FORK_SIGNED_BLOCKS[p.version]
+    if p.blinded:
+        return ssz.serialize(blind_cls(p.block, signature))
+    if p.version not in FORKS_WITH_CONTENTS:
+        return ssz.serialize(full_cls(p.block, signature))
+    return ssz.serialize(
+        _spec.SignedBlockContentsDeneb(
+            full_cls(p.block, signature), p.kzg_proofs, p.blobs
+        )
+    )
+
+
+def signed_proposal_from_ssz(
+    data: bytes, blinded: bool, version: str
+) -> tuple[Proposal, bytes]:
+    """Parse an SSZ publish POST body. Unlike JSON there is no field-set
+    sniffing — the spec REQUIRES the Eth-Consensus-Version header on
+    SSZ requests, so `version` is mandatory."""
+    full_cls, blind_cls = _spec.FORK_SIGNED_BLOCKS[version]
+    if blinded:
+        s = ssz.deserialize(blind_cls, data)
+        return Proposal(version, s.message, True), s.signature
+    if version not in FORKS_WITH_CONTENTS:
+        s = ssz.deserialize(full_cls, data)
+        return Proposal(version, s.message, False), s.signature
+    sc = ssz.deserialize(_spec.SignedBlockContentsDeneb, data)
+    return (
+        Proposal(
+            version,
+            sc.signed_block.message,
+            False,
+            kzg_proofs=tuple(sc.kzg_proofs),
+            blobs=tuple(sc.blobs),
+        ),
+        sc.signed_block.signature,
+    )
+
+
 def signed_proposal_from_json(
     j: dict, blinded: bool, version: str | None = None
 ) -> tuple[Proposal, bytes]:

@@ -42,7 +42,9 @@ from charon_tpu.core.eth2data import (
     ValidatorRegistration,
     VoluntaryExit,
     proposal_data_json,
+    proposal_data_ssz,
     signed_proposal_from_json,
+    signed_proposal_from_ssz,
 )
 from charon_tpu.core.types import Duty, DutyType, PubKey
 from charon_tpu.core.validatorapi import ValidatorAPI, VapiError
@@ -401,6 +403,19 @@ class VapiRouter:
             proposal = await self.vapi.proposal(slot, pubkey)
         except VapiError as e:
             return _err(400, str(e))
+        headers = {
+            "Eth-Consensus-Version": proposal.version,
+            "Eth-Execution-Payload-Blinded": str(proposal.blinded).lower(),
+            "Eth-Execution-Payload-Value": "0",
+            "Eth-Consensus-Block-Value": "0",
+        }
+        if "application/octet-stream" in request.headers.get("Accept", ""):
+            # SSZ response (Lighthouse-style clients prefer it for blocks)
+            return web.Response(
+                body=proposal_data_ssz(proposal),
+                content_type="application/octet-stream",
+                headers=headers,
+            )
         return web.json_response(
             {
                 "version": proposal.version,
@@ -409,7 +424,7 @@ class VapiRouter:
                 "consensus_block_value": "0",
                 "data": proposal_data_json(proposal),
             },
-            headers={"Eth-Consensus-Version": proposal.version},
+            headers=headers,
         )
 
     async def _submit_block(self, request: web.Request) -> web.Response:
@@ -421,10 +436,24 @@ class VapiRouter:
         blinded = "blinded_blocks" in request.path
         version = request.headers.get("Eth-Consensus-Version")
         try:
-            j = await request.json()
-            proposal, signature = signed_proposal_from_json(
-                j, blinded, version
-            )
+            # branch on the RAW header: aiohttp's content_type property
+            # defaults to octet-stream when the header is absent, which
+            # would misroute header-less JSON POSTs to the SSZ path
+            if "octet-stream" in request.headers.get("Content-Type", ""):
+                # SSZ body: the spec requires the consensus-version header
+                if not version:
+                    return _err(
+                        400,
+                        "Eth-Consensus-Version header required for SSZ",
+                    )
+                proposal, signature = signed_proposal_from_ssz(
+                    await request.read(), blinded, version
+                )
+            else:
+                j = await request.json()
+                proposal, signature = signed_proposal_from_json(
+                    j, blinded, version
+                )
         except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
             return _err(400, f"malformed block: {e}")
         # key by PUBKEY via the block's proposer index (ref: router.go

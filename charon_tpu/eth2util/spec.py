@@ -530,6 +530,78 @@ FORK_BLOCKS: dict[str, tuple[type, type]] = {
 }
 
 
+def _signed_cls(name: str, block_cls):
+    cls = dataclasses.make_dataclass(
+        name,
+        [
+            ("message", block_cls),
+            ("signature", bytes, dataclasses.field(default=bytes(96))),
+        ],
+        frozen=True,
+        namespace={
+            "ssz_fields": (ssz.Nested(block_cls), ssz.BYTES96),
+            "hash_tree_root": lambda self: ssz.hash_tree_root(self),
+        },
+    )
+    cls.__module__ = __name__
+    return cls
+
+
+SignedBeaconBlockCapella = _signed_cls(
+    "SignedBeaconBlockCapella", BeaconBlockCapella
+)
+SignedBlindedBeaconBlockCapella = _signed_cls(
+    "SignedBlindedBeaconBlockCapella", BlindedBeaconBlockCapella
+)
+SignedBeaconBlockDeneb = _signed_cls(
+    "SignedBeaconBlockDeneb", BeaconBlockDeneb
+)
+SignedBlindedBeaconBlockDeneb = _signed_cls(
+    "SignedBlindedBeaconBlockDeneb", BlindedBeaconBlockDeneb
+)
+
+# deneb block contents (produce) / signed block contents (publish):
+# block + blob sidecar material as one SSZ container
+BYTES_PER_BLOB = 131072  # 4096 field elements x 32 bytes
+
+
+@dataclass(frozen=True)
+class BlockContentsDeneb:
+    block: Any
+    kzg_proofs: tuple[bytes, ...] = ()
+    blobs: tuple[bytes, ...] = ()
+
+    ssz_fields: ClassVar = (
+        ssz.Nested(BeaconBlockDeneb),
+        ssz.List(ssz.BYTES48, MAX_BLOB_COMMITMENTS_PER_BLOCK),
+        ssz.List(
+            ssz.ByteVector(BYTES_PER_BLOB), MAX_BLOB_COMMITMENTS_PER_BLOCK
+        ),
+    )
+
+
+@dataclass(frozen=True)
+class SignedBlockContentsDeneb:
+    signed_block: Any
+    kzg_proofs: tuple[bytes, ...] = ()
+    blobs: tuple[bytes, ...] = ()
+
+    ssz_fields: ClassVar = (
+        ssz.Nested(SignedBeaconBlockDeneb),
+        ssz.List(ssz.BYTES48, MAX_BLOB_COMMITMENTS_PER_BLOCK),
+        ssz.List(
+            ssz.ByteVector(BYTES_PER_BLOB), MAX_BLOB_COMMITMENTS_PER_BLOCK
+        ),
+    )
+
+
+# version -> (signed full class, signed blinded class)
+FORK_SIGNED_BLOCKS: dict[str, tuple[type, type]] = {
+    "capella": (SignedBeaconBlockCapella, SignedBlindedBeaconBlockCapella),
+    "deneb": (SignedBeaconBlockDeneb, SignedBlindedBeaconBlockDeneb),
+}
+
+
 def block_class(version: str, blinded: bool) -> type:
     try:
         full, blind = FORK_BLOCKS[version]

@@ -188,6 +188,83 @@ def test_signed_proposal_roundtrip_and_sniffing():
     assert p2.version == "capella" and p2 == cap
 
 
+def test_ssz_serialize_roundtrip_all_forks():
+    """Full SSZ wire encoding (offsets, bitlists, nested containers)
+    round-trips every fork block variant and preserves roots."""
+    from charon_tpu.eth2util import ssz
+
+    blk = _rich_deneb_block()
+    wire = ssz.serialize(blk)
+    blk2 = ssz.deserialize(spec.BeaconBlockDeneb, wire)
+    assert blk2 == blk and blk2.hash_tree_root() == blk.hash_tree_root()
+    for version in spec.FORK_BLOCKS:
+        for blinded in (False, True):
+            cls = spec.block_class(version, blinded)
+            b = _mk_block(cls)
+            assert ssz.deserialize(cls, ssz.serialize(b)) == b
+    # offset micro-KAT: fixed uint64, then a 4-byte offset, then the list
+    import dataclasses as dc
+
+    @dc.dataclass(frozen=True)
+    class Pair:
+        a: int
+        b: bytes
+        ssz_fields = (ssz.UINT64, ssz.ByteList(10))
+
+    assert ssz.serialize(Pair(5, b"\xaa\xbb")) == (
+        (5).to_bytes(8, "little") + (12).to_bytes(4, "little") + b"\xaa\xbb"
+    )
+    # malformed offsets are rejected, not misparsed
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        ssz.deserialize(
+            Pair, (5).to_bytes(8, "little") + (99).to_bytes(4, "little")
+        )
+
+
+def test_signed_proposal_ssz_shapes():
+    from charon_tpu.core.eth2data import (
+        proposal_data_ssz,
+        signed_proposal_from_ssz,
+        signed_proposal_ssz,
+    )
+    from charon_tpu.eth2util import ssz
+
+    sig = b"\x2d" * 96
+    full = Proposal(
+        "deneb",
+        _rich_deneb_block(),
+        kzg_proofs=(b"\x01" * 48,),
+        blobs=(b"\x02" * spec.BYTES_PER_BLOB,),
+    )
+    p2, s2 = signed_proposal_from_ssz(
+        signed_proposal_ssz(full, sig), blinded=False, version="deneb"
+    )
+    assert (p2, s2) == (full, sig)
+
+    blinded = Proposal(
+        "deneb", _mk_block(spec.BlindedBeaconBlockDeneb), blinded=True
+    )
+    p2, s2 = signed_proposal_from_ssz(
+        signed_proposal_ssz(blinded, sig), blinded=True, version="deneb"
+    )
+    assert (p2, s2) == (blinded, sig)
+
+    cap = Proposal("capella", _mk_block(spec.BeaconBlockCapella))
+    p2, s2 = signed_proposal_from_ssz(
+        signed_proposal_ssz(cap, sig), blinded=False, version="capella"
+    )
+    assert (p2, s2) == (cap, sig)
+
+    # produce-side SSZ data: deneb full is BlockContents
+    contents = ssz.deserialize(
+        spec.BlockContentsDeneb, proposal_data_ssz(full)
+    )
+    assert contents.block == full.block
+    assert contents.blobs == full.blobs
+
+
 def test_proposal_wire_codec_roundtrip():
     """Fork-versioned proposals ride the consensus/parsigex wire intact
     (ref: corepb carries the full VersionedProposal across peers)."""
@@ -273,6 +350,44 @@ def test_router_keys_proposer_by_pubkey():
                 assert resp.status == 200, await resp.text()
             assert vapi.submitted[0][0] == pk_b
 
+            # SSZ produce (Accept: octet-stream) serves wire bytes with
+            # the version headers; SSZ submit round-trips them
+            from charon_tpu.core.eth2data import (
+                proposal_data_ssz,
+                signed_proposal_ssz,
+            )
+
+            async with s.get(
+                f"{base}/eth/v3/validator/blocks/9",
+                params={"randao_reveal": "0x" + "03" * 96},
+                headers={"Accept": "application/octet-stream"},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.content_type == "application/octet-stream"
+                assert resp.headers["Eth-Consensus-Version"] == "deneb"
+                assert (
+                    resp.headers["Eth-Execution-Payload-Blinded"] == "false"
+                )
+                assert await resp.read() == proposal_data_ssz(prop)
+            async with s.post(
+                f"{base}/eth/v2/beacon/blocks",
+                data=signed_proposal_ssz(prop, b"\x2e" * 96),
+                headers={
+                    "Eth-Consensus-Version": "deneb",
+                    "Content-Type": "application/octet-stream",
+                },
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            assert vapi.submitted[-1][0] == pk_b
+            assert vapi.submitted[-1][2] == b"\x2e" * 96
+            # SSZ submit without the version header is a 400
+            async with s.post(
+                f"{base}/eth/v2/beacon/blocks",
+                data=signed_proposal_ssz(prop, b"\x2f" * 96),
+                headers={"Content-Type": "application/octet-stream"},
+            ) as resp:
+                assert resp.status == 400
+
             # unknown proposer index -> 404, nothing submitted
             import dataclasses
 
@@ -283,7 +398,7 @@ def test_router_keys_proposer_by_pubkey():
                 headers={"Eth-Consensus-Version": "deneb"},
             ) as resp:
                 assert resp.status == 404
-            assert len(vapi.submitted) == 1
+            assert len(vapi.submitted) == 2  # JSON + SSZ submits above
         await router.stop()
 
     asyncio.run(main())
