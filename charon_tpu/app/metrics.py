@@ -8,6 +8,7 @@ HTTP endpoints (/metrics, /readyz, /livez — app/monitoringapi.go:47-122).
 from __future__ import annotations
 
 import asyncio
+import json as _json
 from dataclasses import dataclass
 
 from prometheus_client import (
@@ -147,9 +148,11 @@ async def serve_monitoring(
     metrics: ClusterMetrics,
     health_checker=None,
     ready_fn=None,
+    consensus_dump=None,
 ) -> asyncio.AbstractServer:
-    """Minimal HTTP endpoint: /metrics, /livez, /readyz
-    (ref: app/monitoringapi.go:47)."""
+    """Minimal HTTP endpoint: /metrics, /livez, /readyz, /debug/traces,
+    /debug/consensus (ref: app/monitoringapi.go:47; docs/consensus.md:74
+    for the consensus debugger)."""
 
     async def handle(reader, writer):
         try:
@@ -164,8 +167,6 @@ async def serve_monitoring(
             elif path.startswith("/debug/traces"):
                 # recorded workflow spans (ref: app/monitoringapi.go debug
                 # endpoints + /debug/consensus, docs/consensus.md:74)
-                import json as _json
-
                 from charon_tpu.app import tracer as _tracer
 
                 from urllib.parse import parse_qs, urlsplit
@@ -174,6 +175,12 @@ async def serve_monitoring(
                 trace_id = (query.get("trace_id") or [None])[0]
                 body = _json.dumps(
                     _tracer.global_tracer().dump(trace_id)
+                ).encode()
+                ctype = b"application/json"
+                status = b"200 OK"
+            elif path.startswith("/debug/consensus"):
+                body = _json.dumps(
+                    consensus_dump() if consensus_dump else []
                 ).encode()
                 ctype = b"application/json"
                 status = b"200 OK"

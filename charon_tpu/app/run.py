@@ -449,9 +449,14 @@ async def build_node(config: Config) -> Node:
     life.register_stop(Order.SCHEDULER, "scheduler", stop_sched)
 
     if config.monitoring_port:
+        consensus_dump = getattr(qbft_consensus, "debug_dump", None)
+
         async def start_mon():
             await serve_monitoring(
-                "127.0.0.1", config.monitoring_port, metrics
+                "127.0.0.1",
+                config.monitoring_port,
+                metrics,
+                consensus_dump=consensus_dump,
             )
 
         life.register_start(Order.MONITORING, "monitoring", start_mon, background=False)

@@ -111,6 +111,13 @@ class QBFTConsensus:
             sign_msg=sign_msg,
         )
         self._subs: list[DecidedSub] = []
+        # Consensus sniffer: bounded ring of recent message summaries
+        # (in/out), served at /debug/consensus for post-mortem debugging
+        # (ref: core/consensus/qbft/sniffer.go buffers instances for the
+        # debugger endpoint, docs/consensus.md:74).
+        from collections import deque
+
+        self._sniffer: deque = deque(maxlen=512)
         # Per-duty values-by-hash cache: messages for one instance carry
         # only that instance's candidate values (ref: transport.go:63-90
         # keeps values per consensus instance, not globally).
@@ -148,6 +155,7 @@ class QBFTConsensus:
         if tr is None:
 
             async def bcast(msg: qbft.Msg) -> None:
+                self._sniff("out", duty, msg)
                 await self.net.broadcast(
                     self.node_idx,
                     duty,
@@ -168,6 +176,7 @@ class QBFTConsensus:
         (ref: core/consensus/qbft/qbft.go valuesByHash recomputes)."""
         if self._gater is not None and not self._gater(duty):
             return
+        self._sniff("in", duty, msg)
         # Inbox first: if the sender is over its per-source buffer bound,
         # its value payloads are dropped too — otherwise the cache merge
         # would be an unbounded-memory side channel around the bound.
@@ -185,6 +194,31 @@ class QBFTConsensus:
             except Exception:
                 continue
             cache.setdefault(rh, v)
+
+    def _sniff(self, direction: str, duty: Duty, msg: qbft.Msg) -> None:
+        import time as _time
+
+        self._sniffer.append(
+            {
+                "ts": round(_time.time(), 3),
+                "dir": direction,
+                "duty": str(duty),
+                "type": getattr(msg.type, "name", str(msg.type)),
+                "round": msg.round,
+                "source": msg.source,
+                "value": (
+                    msg.value.hex()[:16]
+                    if isinstance(msg.value, bytes)
+                    else (str(msg.value)[:16] if msg.value is not None else None)
+                ),
+                "justification": len(msg.justification or ()),
+            }
+        )
+
+    def debug_dump(self) -> list[dict]:
+        """Recent consensus messages, oldest first (served at
+        /debug/consensus; ref: docs/consensus.md:74)."""
+        return list(self._sniffer)
 
     def _ensure_running(self, duty: Duty, value_hash_or_none) -> asyncio.Task:
         task = self._running.get(duty)

@@ -91,6 +91,22 @@ def lagrange_coeffs_at_zero(fr_ctx: ModCtx, idx, t: int):
 # ---------------------------------------------------------------------------
 
 
+def clear_kernel_caches() -> None:
+    """Drop every cached jitted kernel so the next call RE-TRACES.
+
+    The degradation ladders (bench.py, tbls/tpu_impl.py) flip trace-time
+    routing flags (fptower.set_fp2_fusion, limb.set_pallas); without
+    this, the lru-cached jit wrappers keep returning the already-compiled
+    executable and the flag flip never takes effect."""
+    import sys
+
+    mod = sys.modules[__name__]
+    for name in dir(mod):
+        fn = getattr(mod, name)
+        if callable(fn) and hasattr(fn, "cache_clear"):
+            fn.cache_clear()
+
+
 @functools.lru_cache(maxsize=None)
 def _threshold_agg_kernel(ctx: ModCtx, fr_ctx: ModCtx, t: int):
     f = C.g2_ops(ctx)

@@ -63,6 +63,9 @@ class TPUImpl(Implementation):
         self.engine = engine or blsops.default_engine()
         self.verify_inputs = verify_inputs
         self._host = PythonImpl()
+        # degradation ladder for device failures in the RLC batch path
+        # (mirrors bench.py): fused-fp2 off first, then RLC off entirely
+        self._degrade_rungs = ["fp2-fusion-off"]
 
     # -- host-side secret ops (delegate to the Python backend) ------------
 
@@ -127,7 +130,12 @@ class TPUImpl(Implementation):
             except TblsError:
                 ok[i] = False
                 pks[i] = msgs[i] = sigs[i] = None
-        if n >= self.RLC_MIN_BATCH and self._rlc_accepts(items, pks, msgs, sigs):
+        accepted = (
+            self._rlc_guarded(items, pks, msgs, sigs)
+            if n >= self.RLC_MIN_BATCH
+            else False
+        )
+        if accepted:
             # the whole batch verified in one shared-final-exp program;
             # decode failures (ok[i] False) pass None lanes which
             # contribute neutrally and stay False below
@@ -139,6 +147,45 @@ class TPUImpl(Implementation):
         else:
             in_subgroup = [True] * n
         return [o and v and s for o, v, s in zip(ok, verified, in_subgroup)]
+
+    def _rlc_guarded(self, items, pks, msgs, sigs) -> bool:
+        """_rlc_accepts with device-failure containment: a COMPILE or
+        runtime error on the accelerator is not a crypto verdict — step
+        down the same degradation ladder as bench.py (fused-fp2 off with
+        the jit caches cleared so the flag actually re-traces, then RLC
+        off for this impl) and keep serving verifies on the per-lane
+        engine rather than breaking the duty pipeline."""
+        while True:
+            try:
+                return self._rlc_accepts(items, pks, msgs, sigs)
+            except TblsError:
+                raise
+            except Exception as e:  # noqa: BLE001 — device/compile failure
+                from charon_tpu.app import log
+                from charon_tpu.ops import fptower
+
+                rung = self._degrade_rungs.pop(0) if self._degrade_rungs else None
+                if rung == "fp2-fusion-off" and not fptower._FP2_FUSION:
+                    # another impl already burned this rung process-wide;
+                    # retrying the identical path would fail identically
+                    rung = None
+                log.warn(
+                    "RLC batch path failed on device; degrading",
+                    topic="tbls",
+                    err=f"{type(e).__name__}: {str(e)[:160]}",
+                    rung=rung or "rlc-disabled",
+                )
+                if rung == "fp2-fusion-off":
+                    from charon_tpu.ops import blsops
+
+                    fptower.set_fp2_fusion(False)
+                    # the flag is read at TRACE time: without dropping the
+                    # cached jit wrappers the retry re-runs the identical
+                    # compiled fused executable
+                    blsops.clear_kernel_caches()
+                    continue
+                self.RLC_MIN_BATCH = 1 << 62  # disables RLC for this impl
+                return False
 
     # At most this many distinct messages take the grouped kernel (one
     # Miller pair per message); beyond it, the ungrouped RLC kernel.

@@ -1,0 +1,44 @@
+"""Fresh-subprocess isolation for compile-heavy JAX test bodies.
+
+This image's jaxlib flakily segfaults (de)serializing large XLA:CPU
+executables to the persistent cache once a process has accumulated many
+compiled programs (CI.md "Known environment flake") — the reliable
+trigger is a fresh compile landing LATE in a program-heavy run. Tests
+that would do that execute their body here instead: a fresh process with
+the platform pinned to CPU (the image's sitecustomize would otherwise
+claim the TPU tunnel) and the shared persistent cache.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ISOLATED_HEADER = f"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", {os.path.join(REPO, ".jax_cache")!r})
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+"""
+
+
+def run_isolated(script: str, marker: str, timeout: float = 1500) -> None:
+    """Run `script` (usually ISOLATED_HEADER + body) in a fresh python;
+    assert exit 0 and that `marker` was printed."""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env={**os.environ, "PYTHONPATH": REPO},
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"isolated test failed rc={proc.returncode}:\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert marker in proc.stdout

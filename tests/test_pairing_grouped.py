@@ -80,27 +80,38 @@ def test_grouped_rejects_wrong_group_signature(kernel):
     assert not bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
 
 
+# Runs in a FRESH subprocess: compiling the m=4 engine shape after this
+# process has accumulated many programs triggers the image's jaxlib
+# persistent-cache segfault (CI.md "Known environment flake"; same
+# containment as tests/test_tbls.py's RLC-path tests — shared harness in
+# tests/isolation_util.py).
+_PAD_PATH_SCRIPT = """
+from charon_tpu.crypto import bls, h2c
+from charon_tpu.ops.blsops import BlsEngine
+
+eng = BlsEngine()
+groups = []
+for m in range(3):
+    raw = b"padpath-msg-%d" % m
+    sk = bls.keygen(bytes([40 + m]) * 32)
+    groups.append((h2c.hash_to_g2(raw), [(bls.sk_to_pk(sk), bls.sign(sk, raw))]))
+assert eng.verify_batch_grouped_rlc(groups)
+bad = list(groups)
+bad[2] = (groups[2][0], groups[1][1])  # sig for another group's msg
+assert not eng.verify_batch_grouped_rlc(bad)
+print("PAD-PATH-OK")
+"""
+
+
 def test_engine_grouped_pads_m3_to_4():
     """BlsEngine.verify_batch_grouped_rlc with THREE distinct messages
     pads the group grid to 4 (identity msg point + identity bucket
     entering the Miller stage). A regression in the pad path would make
     every non-pow2 distinct-message batch fail and silently degrade to
     the per-lane fallback."""
-    from charon_tpu.ops.blsops import BlsEngine
+    from isolation_util import ISOLATED_HEADER, run_isolated
 
-    eng = BlsEngine()
-    groups = []
-    for m in range(3):
-        raw = b"padpath-msg-%d" % m
-        sk = bls.keygen(bytes([40 + m]) * 32)
-        groups.append(
-            (h2c.hash_to_g2(raw), [(bls.sk_to_pk(sk), bls.sign(sk, raw))])
-        )
-    assert eng.verify_batch_grouped_rlc(groups)
-    # and a forged lane in the padded grid still fails
-    bad = list(groups)
-    bad[2] = (groups[2][0], groups[1][1])  # sig for another group's msg
-    assert not eng.verify_batch_grouped_rlc(bad)
+    run_isolated(ISOLATED_HEADER + _PAD_PATH_SCRIPT, "PAD-PATH-OK")
 
 
 def test_grouped_zero_exponent_lanes_neutral(kernel):

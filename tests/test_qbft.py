@@ -161,3 +161,48 @@ def test_late_value_via_future():
         assert set(decided) == {f"v{leader}"}
 
     asyncio.run(run())
+
+
+def test_consensus_sniffer_and_debug_endpoint():
+    """The adapter records a bounded ring of in/out message summaries
+    and serves it at /debug/consensus (ref: core/consensus/qbft/
+    sniffer.go + docs/consensus.md:74 debugger endpoint)."""
+    import json
+    import urllib.request
+
+    from charon_tpu.app.metrics import ClusterMetrics, serve_monitoring
+    from charon_tpu.core.consensus_qbft import MemMsgNet, QBFTConsensus
+    from charon_tpu.core.types import Duty, DutyType
+
+    async def main():
+        net = MemMsgNet()
+        nodes = [QBFTConsensus(net, 4, round_timeout=0.2) for _ in range(4)]
+        duty = Duty(slot=9, type=DutyType.ATTESTER)
+        await asyncio.wait_for(
+            asyncio.gather(
+                *(n.propose(duty, {"pk": "value"}) for n in nodes)
+            ),
+            10,
+        )
+        dump = nodes[0].debug_dump()
+        assert dump, "sniffer recorded nothing"
+        assert {d["dir"] for d in dump} == {"in", "out"}
+        assert any(d["type"] == "PRE_PREPARE" for d in dump)
+        assert all(d["duty"] == str(duty) for d in dump)
+
+        # served over the monitoring endpoint
+        metrics = ClusterMetrics(cluster_hash="00", cluster_name="t", peer="n0")
+        server = await serve_monitoring(
+            "127.0.0.1", 0, metrics, consensus_dump=nodes[0].debug_dump
+        )
+        port = server.sockets[0].getsockname()[1]
+        body = await asyncio.to_thread(
+            lambda: urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/consensus", timeout=3
+            ).read()
+        )
+        served = json.loads(body)
+        assert served and served[0]["duty"] == str(duty)
+        server.close()
+
+    asyncio.run(main())

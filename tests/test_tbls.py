@@ -167,20 +167,10 @@ def test_randomized_impl_full_suite(impls, cluster):
         tbls.set_implementation(impls[0])
 
 
-# The two RLC-path tests run in FRESH subprocesses: this image's jaxlib
-# flakily segfaults (de)serializing large CPU executables to the
-# persistent cache once a process has accumulated many compiled programs
-# (see CI.md "Known environment flake") — process isolation sidesteps it.
-# pins the CPU platform + shared cache exactly like conftest (the child
-# process does not import conftest, and the image's sitecustomize would
-# otherwise claim the TPU tunnel)
-_ISOLATED_HEADER = """
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-"""
+# The two RLC-path tests run in FRESH subprocesses (shared harness in
+# tests/isolation_util.py; see CI.md "Known environment flake").
+from isolation_util import ISOLATED_HEADER as _ISOLATED_HEADER
+from isolation_util import run_isolated as _run_isolated_shared
 
 _RLC_PATH_SCRIPT = _ISOLATED_HEADER + """
 from charon_tpu.tbls.tpu_impl import TPUImpl
@@ -225,23 +215,7 @@ print("GROUPED-PATH-OK")
 
 
 def _run_isolated(script: str, marker: str) -> None:
-    import os
-    import subprocess
-    import sys
-
-    proc = subprocess.run(
-        [sys.executable, "-c", script],
-        capture_output=True,
-        text=True,
-        timeout=1500,
-        env={**os.environ, "PYTHONPATH": os.getcwd()},
-        cwd=os.getcwd(),
-    )
-    assert proc.returncode == 0, (
-        f"isolated RLC test failed rc={proc.returncode}:\n"
-        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
-    )
-    assert marker in proc.stdout
+    _run_isolated_shared(script, marker)
 
 
 def test_tpu_verify_batch_rlc_path():
@@ -255,3 +229,53 @@ def test_tpu_verify_batch_grouped_path():
     kernel verifies the batch; a wrong-key lane still gets attributed by
     the per-lane fallback."""
     _run_isolated(_GROUPED_PATH_SCRIPT, "GROUPED-PATH-OK")
+
+
+def test_tpu_impl_degrades_on_device_failure():
+    """A device/compile failure inside the RLC batch path is NOT a
+    crypto verdict: the impl steps down the degradation ladder
+    (fused-fp2 off, then RLC off) and keeps serving verifies on the
+    per-lane engine instead of breaking the duty pipeline."""
+    from unittest import mock
+
+    from charon_tpu.ops import fptower
+    from charon_tpu.tbls.tpu_impl import TPUImpl
+
+    class FakeEngine:
+        def verify_batch(self, pks, msgs, sigs):
+            return [True] * len(pks)
+
+        def subgroup_check_g2_batch(self, sigs):
+            return [True] * len(sigs)
+
+    from charon_tpu.tbls.python_impl import PythonImpl
+
+    py = PythonImpl()
+    impl = TPUImpl(engine=FakeEngine(), verify_inputs=False)
+    impl.RLC_MIN_BATCH = 1
+    sk = py.generate_secret_key()
+    pk = py.secret_to_public_key(sk)
+    items = [(pk, b"m", py.sign(sk, b"m"))] * 2
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("MOSAIC lowering failed")
+
+    try:
+        with mock.patch.object(impl, "_rlc_accepts", boom):
+            out = impl.verify_batch(items)
+        # fell back to the per-lane engine, duty pipeline kept working
+        assert out == [True, True]
+        # ladder: first failure disabled fusion and retried, second
+        # failure disabled RLC for the session
+        assert calls["n"] == 2
+        assert fptower._FP2_FUSION is False
+        assert impl.RLC_MIN_BATCH > 10**9
+        # subsequent batches skip RLC without touching the broken path
+        with mock.patch.object(impl, "_rlc_accepts", boom):
+            assert impl.verify_batch(items) == [True, True]
+        assert calls["n"] == 2
+    finally:
+        fptower.set_fp2_fusion(True)
