@@ -169,6 +169,31 @@ def build_parser() -> argparse.ArgumentParser:
         "--partials", nargs="+", required=True, help="partial-exit json files"
     )
     ebcast.add_argument("--output", default="", help="signed-exit json path")
+    elist = exitsub.add_parser(
+        "list",
+        help="list the cluster's validators eligible for exit "
+        "(ref: cmd/exit_list.go)",
+    )
+    elist.add_argument("--data-dir", required=True)
+    elist.add_argument(
+        "--beacon-url",
+        default="",
+        help="also resolve on-chain index + status from this beacon node",
+    )
+    efetch = exitsub.add_parser(
+        "fetch",
+        help="fetch aggregated signed exits from the publish API "
+        "(ref: cmd/exit_fetch.go)",
+    )
+    efetch.add_argument("--data-dir", required=True)
+    efetch.add_argument(
+        "--publish-address", required=True, help="obol publish API base URL"
+    )
+    efetch.add_argument(
+        "--fetched-exit-path",
+        default="",
+        help="directory to store fetched signed exits (default: data dir)",
+    )
     ebcast.add_argument(
         "--beacon-url", default="", help="POST the exit to this beacon node"
     )
@@ -608,6 +633,77 @@ def cmd_exit(args) -> int:
         )
         Path(path).write_text(json.dumps(out, indent=2))
         print(f"wrote partial exit {path}")
+        return 0
+
+    if args.exit_command == "list":
+        # ref: cmd/exit_list.go — the cluster's validators with (when a
+        # BN is reachable) their on-chain index and status
+        rows = []
+        chain: dict[str, dict] = {}
+        if args.beacon_url:
+            import aiohttp
+
+            async def fetch_statuses():
+                async with aiohttp.ClientSession() as s:
+                    ids = ",".join(
+                        dv.distributed_public_key for dv in lock.validators
+                    )
+                    async with s.get(
+                        args.beacon_url.rstrip("/")
+                        + "/eth/v1/beacon/states/head/validators",
+                        params={"id": ids},
+                    ) as resp:
+                        if resp.status != 200:
+                            raise RuntimeError(
+                                f"beacon validators query: HTTP {resp.status}"
+                            )
+                        for v in (await resp.json())["data"]:
+                            chain[v["validator"]["pubkey"].lower()] = v
+
+            asyncio.run(fetch_statuses())
+        for i, dv in enumerate(lock.validators):
+            onchain = chain.get(dv.distributed_public_key.lower(), {})
+            rows.append(
+                {
+                    "cluster_index": i,
+                    "validator_pubkey": dv.distributed_public_key,
+                    "validator_index": onchain.get("index"),
+                    "status": onchain.get("status"),
+                }
+            )
+        print(json.dumps(rows, indent=2))
+        return 0
+
+    if args.exit_command == "fetch":
+        # ref: cmd/exit_fetch.go — pull the aggregated signed exit for
+        # each cluster validator from the publish API once threshold
+        # partial shares were uploaded
+        from charon_tpu.app.obolapi import ObolApiClient
+
+        client = ObolApiClient(args.publish_address)
+        out_dir = Path(args.fetched_exit_path or data_dir)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        lock_hash = lock.lock_hash()
+
+        async def fetch_all() -> int:
+            fetched = 0
+            for i, dv in enumerate(lock.validators):
+                full = await client.fetch_full_exit(
+                    lock_hash, dv.distributed_public_key
+                )
+                if full is None:
+                    print(
+                        f"validator {i}: exit not ready (needs threshold "
+                        "partial shares)",
+                    )
+                    continue
+                path = out_dir / f"exit-{dv.distributed_public_key}.json"
+                path.write_text(json.dumps(full, indent=2))
+                print(f"validator {i}: wrote {path}")
+                fetched += 1
+            return fetched
+
+        asyncio.run(fetch_all())
         return 0
 
     # broadcast: aggregate >= t partials, verify, emit/submit

@@ -288,3 +288,87 @@ def test_test_performance(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "disk_write:" in out and "sha256:" in out and "bls_verify_host:" in out
+
+
+def test_exit_list(cluster):
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert (
+            cli.main(
+                ["exit", "list", "--data-dir", str(cluster / "node0")]
+            )
+            == 0
+        )
+    rows = json.loads(buf.getvalue())
+    assert len(rows) == 2  # fixture cluster has two validators
+    assert rows[0]["cluster_index"] == 0
+    assert rows[0]["validator_pubkey"].startswith("0x")
+    assert rows[0]["status"] is None  # no beacon node queried
+
+
+def test_exit_fetch_via_publish_api(cluster, tmp_path):
+    """Partial exits upload to the publish API; once threshold shares
+    land, `exit fetch` retrieves the aggregated exit for every
+    validator (ref: cmd/exit_fetch.go + obolapi GetFullExit)."""
+    import asyncio
+
+    from charon_tpu.app.obolapi import ObolApiClient
+    from charon_tpu.cluster.manifest import load_cluster_state
+    from charon_tpu.testutil.obolapimock import ObolApiMock
+
+    lock = load_cluster_state(cluster / "node0")
+    lock_hash = lock.lock_hash()
+    dv = lock.validators[0]
+
+    async def run_flow():
+        mock = ObolApiMock(threshold=3)
+        port = await mock.start()
+        try:
+            client = ObolApiClient(f"http://127.0.0.1:{port}")
+            # upload 3 partials signed by the first three nodes
+            for i in range(3):
+                out = tmp_path / f"pex-{i}.json"
+                assert (
+                    cli.main(
+                        [
+                            "exit", "sign",
+                            "--data-dir", str(cluster / f"node{i}"),
+                            "--validator-index", "0",
+                            "--epoch", "99",
+                            "--output", str(out),
+                        ]
+                    )
+                    == 0
+                )
+                p = json.loads(out.read_text())
+                await client.submit_partial_exit(
+                    lock_hash,
+                    p["share_idx"],
+                    p["validator_pubkey"],
+                    p["epoch"],
+                    bytes.fromhex(p["partial_signature"]),
+                )
+            # now the CLI fetch stores the aggregated exit
+            out_dir = tmp_path / "fetched"
+            assert (
+                cli.main(
+                    [
+                        "exit", "fetch",
+                        "--data-dir", str(cluster / "node0"),
+                        "--publish-address", f"http://127.0.0.1:{port}",
+                        "--fetched-exit-path", str(out_dir),
+                    ]
+                )
+                == 0
+            )
+            path = out_dir / f"exit-{dv.distributed_public_key}.json"
+            fetched = json.loads(path.read_text())
+            assert fetched["epoch"] == 99
+            assert fetched["signature"].startswith("0x")
+        finally:
+            await mock.stop()
+
+    asyncio.run(run_flow())
