@@ -312,8 +312,13 @@ def test_exit_list(cluster):
 def test_exit_fetch_via_publish_api(cluster, tmp_path):
     """Partial exits upload to the publish API; once threshold shares
     land, `exit fetch` retrieves the aggregated exit for every
-    validator (ref: cmd/exit_fetch.go + obolapi GetFullExit)."""
+    validator (ref: cmd/exit_fetch.go + obolapi GetFullExit).
+
+    The mock API serves from a background thread's event loop so the
+    synchronous CLI (which blocks this thread while it does HTTP) always
+    has a live server to talk to."""
     import asyncio
+    import threading
 
     from charon_tpu.app.obolapi import ObolApiClient
     from charon_tpu.cluster.manifest import load_cluster_state
@@ -323,52 +328,63 @@ def test_exit_fetch_via_publish_api(cluster, tmp_path):
     lock_hash = lock.lock_hash()
     dv = lock.validators[0]
 
-    async def run_flow():
-        mock = ObolApiMock(threshold=3)
-        port = await mock.start()
-        try:
-            client = ObolApiClient(f"http://127.0.0.1:{port}")
-            # upload 3 partials signed by the first three nodes
-            for i in range(3):
-                out = tmp_path / f"pex-{i}.json"
-                assert (
-                    cli.main(
-                        [
-                            "exit", "sign",
-                            "--data-dir", str(cluster / f"node{i}"),
-                            "--validator-index", "0",
-                            "--epoch", "99",
-                            "--output", str(out),
-                        ]
-                    )
-                    == 0
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    def in_server_loop(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=30)
+
+    mock = ObolApiMock(threshold=3)
+    port = in_server_loop(mock.start())
+    try:
+        client = ObolApiClient(f"http://127.0.0.1:{port}")
+        # upload 3 partials signed by the first three nodes
+        for i in range(3):
+            out = tmp_path / f"pex-{i}.json"
+            assert (
+                cli.main(
+                    [
+                        "exit", "sign",
+                        "--data-dir", str(cluster / f"node{i}"),
+                        "--validator-index", "0",
+                        "--epoch", "99",
+                        "--output", str(out),
+                    ]
                 )
-                p = json.loads(out.read_text())
-                await client.submit_partial_exit(
+                == 0
+            )
+            p = json.loads(out.read_text())
+            in_server_loop(
+                client.submit_partial_exit(
                     lock_hash,
                     p["share_idx"],
                     p["validator_pubkey"],
                     p["epoch"],
                     bytes.fromhex(p["partial_signature"]),
                 )
-            # now the CLI fetch stores the aggregated exit
-            out_dir = tmp_path / "fetched"
-            assert (
-                cli.main(
-                    [
-                        "exit", "fetch",
-                        "--data-dir", str(cluster / "node0"),
-                        "--publish-address", f"http://127.0.0.1:{port}",
-                        "--fetched-exit-path", str(out_dir),
-                    ]
-                )
-                == 0
             )
-            path = out_dir / f"exit-{dv.distributed_public_key}.json"
-            fetched = json.loads(path.read_text())
-            assert fetched["epoch"] == 99
-            assert fetched["signature"].startswith("0x")
+        # now the CLI fetch stores the aggregated exit
+        out_dir = tmp_path / "fetched"
+        assert (
+            cli.main(
+                [
+                    "exit", "fetch",
+                    "--data-dir", str(cluster / "node0"),
+                    "--publish-address", f"http://127.0.0.1:{port}",
+                    "--fetched-exit-path", str(out_dir),
+                ]
+            )
+            == 0
+        )
+        path = out_dir / f"exit-{dv.distributed_public_key}.json"
+        fetched = json.loads(path.read_text())
+        assert fetched["epoch"] == 99
+        assert fetched["signature"].startswith("0x")
+    finally:
+        try:
+            in_server_loop(mock.stop())
         finally:
-            await mock.stop()
-
-    asyncio.run(run_flow())
+            loop.call_soon_threadsafe(loop.stop)
+            thread.join(timeout=10)
+            loop.close()
