@@ -6,16 +6,18 @@
 # Also drops a timestamped probe line every ~15 min so a tunnel-dead
 # round has an auditable post-mortem trail (VERDICT r3 next-step 1).
 log=/root/repo/bench_r4_auto.log
-echo "[watch $(date +%H:%M:%S)] start (round 4)" >> "$log"
+# single source of truth for the relay probe port: bench_common.py
+port=$(cd /root/repo && python -c 'import bench_common; print(bench_common.RELAY_PROBE_PORT)')
+echo "[watch $(date +%H:%M:%S)] start (round 4), probing port $port" >> "$log"
 n=0
 while true; do
-  if timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/8083" 2>/dev/null; then
-    echo "[watch $(date +%H:%M:%S)] port 8083 OPEN - launching bench" >> "$log"
+  if timeout 3 bash -c "echo > /dev/tcp/127.0.0.1/$port" 2>/dev/null; then
+    echo "[watch $(date +%H:%M:%S)] port $port OPEN - launching bench" >> "$log"
     break
   fi
   n=$((n+1))
   if [ $((n % 20)) -eq 0 ]; then
-    echo "[watch $(date +%H:%M:%S)] port 8083 still refusing connect (probe $n)" >> "$log"
+    echo "[watch $(date +%H:%M:%S)] port $port still refusing connect (probe $n)" >> "$log"
   fi
   sleep 45
 done

@@ -59,10 +59,13 @@ def pick_batches(platform: str) -> list[int]:
     small cached shape — XLA:CPU compiles of the big pairing program
     take tens of minutes on this 1-core VM and the number is a
     liveness/honesty datapoint, not the headline."""
-    if "BENCH_BATCHES" in os.environ:
+    tunnel_fallback = bool(os.environ.get("CHARON_BENCH_TUNNEL"))
+    if "BENCH_BATCHES" in os.environ and not (platform == "cpu" and tunnel_fallback):
         return [int(b) for b in os.environ["BENCH_BATCHES"].split()]
     if platform != "cpu":
         return [1024, 512, 256]
+    # a BENCH_BATCHES meant for the TPU sweep must not leak through the
+    # dead-tunnel CPU re-exec: batch 4096 on XLA:CPU compiles for hours
     return [int(b) for b in os.environ.get("BENCH_BATCHES_CPU", "16").split()]
 
 T0 = time.perf_counter()

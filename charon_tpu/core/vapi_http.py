@@ -472,9 +472,11 @@ class VapiRouter:
                 if getattr(dd, "validator_index", None) == proposal.proposer_index:
                     pubkey = pk
                     break
-            else:
-                if len(defs) == 1:
-                    (pubkey,) = defs
+            # no single-def fallback: attributing a mismatched
+            # proposer_index to the slot's only duty holder would be
+            # caught by share-signature verification downstream, but
+            # masks the VC's actual misconfiguration as a bad signature;
+            # the 404 below is the actionable answer
         if pubkey is None:
             return _err(
                 404,

@@ -637,6 +637,13 @@ def bits_from_bytes(data: bytes, sentinel: bool, length: int | None = None):
         total = (len(data) - 1) * 8 + data[-1].bit_length() - 1
     else:
         assert length is not None
+        # truncated/oversized hex must be a ValueError (-> HTTP 400 in the
+        # vapi handlers), not an IndexError 500; padding bits above
+        # `length` must be zero (same canonicality rule as ssz._decode)
+        if len(data) != (length + 7) // 8:
+            raise ValueError("bitvector byte length mismatch")
+        if length % 8 and data[-1] >> (length % 8):
+            raise ValueError("bitvector has nonzero padding bits")
         total = length
     return tuple(
         bool(data[i // 8] >> (i % 8) & 1) for i in range(total)
@@ -677,7 +684,10 @@ def _dec(t: ssz.SSZType, v: Any) -> Any:
     if isinstance(t, (ssz.ByteVector, ssz.ByteList)):
         return unhex0x(v)
     if isinstance(t, ssz.Bitlist):
-        return bits_from_bytes(unhex0x(v), sentinel=True)
+        bits = bits_from_bytes(unhex0x(v), sentinel=True)
+        if len(bits) > t.limit:
+            raise ValueError("bitlist exceeds limit")
+        return bits
     if isinstance(t, ssz.Bitvector):
         return bits_from_bytes(unhex0x(v), sentinel=False, length=t.length)
     if isinstance(t, ssz.Nested):

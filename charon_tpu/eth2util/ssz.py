@@ -338,6 +338,10 @@ def _decode(t: SSZType, data: bytes) -> Any:
     if isinstance(t, Bitvector):
         if len(data) != (t.length + 7) // 8:
             raise ValueError("bitvector length mismatch")
+        # canonical encoding: padding bits above `length` must be zero
+        # (two distinct byte strings must not decode to the same value)
+        if t.length % 8 and data[-1] >> (t.length % 8):
+            raise ValueError("bitvector has nonzero padding bits")
         return tuple(
             bool(data[i // 8] >> (i % 8) & 1) for i in range(t.length)
         )

@@ -241,3 +241,33 @@ def test_keymanager_import_keystores():
     asyncio.run(main())
     assert _json.loads(received["keystores"][0])["pubkey"] == "aa"
     assert received["passwords"] == ["pw"]
+
+
+def test_ssz_bitvector_rejects_nonzero_padding():
+    """Canonical SSZ: padding bits above `length` must be zero — two
+    distinct wire byte strings must not decode to the same value
+    (ADVICE r3: consensus spec rejects non-canonical encodings)."""
+    from charon_tpu.eth2util import ssz
+
+    t = ssz.Bitvector(length=4)
+    good = ssz._decode(t, b"\x0f")
+    assert good == (True, True, True, True)
+    with pytest.raises(ValueError, match="padding"):
+        ssz._decode(t, b"\x1f")  # bit 4 set above length
+
+
+def test_json_bitfields_strict():
+    """JSON bitfield decoding: truncated/oversized hex and over-limit
+    bitlists are ValueError (-> HTTP 400), never IndexError (ADVICE r3)."""
+    from charon_tpu.eth2util import spec, ssz
+
+    # truncated bitvector hex used to IndexError deep in bits_from_bytes
+    with pytest.raises(ValueError):
+        spec._dec(ssz.Bitvector(length=64), "0x00")
+    with pytest.raises(ValueError):
+        spec._dec(ssz.Bitvector(length=8), "0x0000")
+    # an aggregation_bits payload above the type limit must fail at
+    # decode, not later at hash_tree_root
+    with pytest.raises(ValueError, match="limit"):
+        spec._dec(ssz.Bitlist(limit=4), "0xff01")
+    assert spec._dec(ssz.Bitlist(limit=4), "0x1f") == (True,) * 4
