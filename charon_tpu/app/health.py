@@ -1,19 +1,30 @@
 """Health checks over a rolling in-memory metric window.
 
 Mirrors ref: app/health — a 10-minute rolling store of samples from the
-node's own metrics, evaluated by declarative checks
-(health/checker.go, checks health/checks.go:41-151): beacon node syncing,
-insufficient connected peers, high error rates, pending duties.
+node's OWN metrics, evaluated by a declarative check catalogue
+(health/checker.go; catalogue health/checks.go:41-151): error/warning
+log rates scaled by validator count, beacon-node sync state, connected
+peer quorum, proposal failures, registration-recast failures — plus
+clock skew from the peerinfo exchange (the reference surfaces it through
+monitoring readiness, app/monitoringapi.go).
+
+Severity semantics (ref: checks.go severityCritical/Warning/Info):
+critical failures gate /readyz; warnings and infos are reported in the
+readyz body and metrics but do not flip readiness.
 """
 
 from __future__ import annotations
 
 import time
 from collections import defaultdict, deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable
 
 WINDOW_SECS = 600.0  # ref: app/health 10-minute window
+
+SEVERITY_CRITICAL = "critical"
+SEVERITY_WARNING = "warning"
+SEVERITY_INFO = "info"
 
 
 class MetricStore:
@@ -32,8 +43,14 @@ class MetricStore:
         q = self._series.get(name)
         return q[-1][1] if q else default
 
+    def max(self, name: str, default: float = 0.0) -> float:
+        """Max over the window (ref: checker.go gaugeMax)."""
+        q = self._series.get(name)
+        return max((v for _, v in q), default=default) if q else default
+
     def increase(self, name: str) -> float:
-        """Increase of a counter over the window."""
+        """Increase of a counter over the window (ref: checker.go
+        increase)."""
         q = self._series.get(name)
         if not q or len(q) < 2:
             return 0.0
@@ -41,47 +58,108 @@ class MetricStore:
 
 
 @dataclass
+class Metadata:
+    """Cluster facts the checks scale by (ref: health.Metadata)."""
+
+    num_validators: int = 1
+    quorum: int = 2
+
+
+@dataclass
 class Check:
     name: str
     description: str
-    failing: Callable[[MetricStore], bool]
+    failing: Callable[[MetricStore, Metadata], bool]
+    severity: str = SEVERITY_WARNING
 
 
-def default_checks(quorum: int) -> list[Check]:
-    """ref: health/checks.go:41-151 (beacon sync, peer connectivity,
-    error spikes, duty failures)."""
+def default_checks() -> list[Check]:
+    """The reference catalogue (ref: health/checks.go:41-151) evaluated
+    over this node's own sampled metrics."""
     return [
         Check(
+            "high_error_log_rate",
+            "high rate of error logs (allow 2 per validator per window)",
+            lambda m, md: m.increase("app_log_errors")
+            > 2 * md.num_validators,
+            SEVERITY_WARNING,
+        ),
+        Check(
+            "high_warning_log_rate",
+            "high rate of warning logs (allow 2 per validator per window)",
+            lambda m, md: m.increase("app_log_warnings")
+            > 2 * md.num_validators,
+            SEVERITY_WARNING,
+        ),
+        Check(
             "beacon_node_syncing",
-            "beacon node is syncing",
-            lambda m: m.latest("app_beacon_syncing") > 0,
+            "beacon node is in syncing state",
+            lambda m, md: m.max("app_beacon_syncing") > 0,
+            SEVERITY_CRITICAL,
         ),
         Check(
-            "insufficient_peers",
-            "fewer than quorum-1 peers connected",
-            lambda m: m.latest("p2p_peers_connected") < quorum - 1,
+            "insufficient_connected_peers",
+            "not connected to at least quorum-1 peers",
+            lambda m, md: m.max("p2p_peers_connected") < md.quorum - 1,
+            SEVERITY_CRITICAL,
         ),
         Check(
-            "high_error_rate",
-            "log error rate spiked in the window",
-            lambda m: m.increase("app_log_errors") > 10,
+            "proposal_failures",
+            "proposal duties failed in the window",
+            lambda m, md: m.increase("core_tracker_failed_proposals") > 0,
+            SEVERITY_WARNING,
         ),
         Check(
             "failed_duties",
             "duties failed in the window",
-            lambda m: m.increase("core_tracker_failed_duties") > 0,
+            lambda m, md: m.increase("core_tracker_failed_duties") > 0,
+            SEVERITY_WARNING,
+        ),
+        Check(
+            "high_registration_failures_rate",
+            "validator-registration recasts failed in the window",
+            lambda m, md: m.increase("core_bcast_recast_errors") > 0,
+            SEVERITY_WARNING,
+        ),
+        Check(
+            "high_clock_skew",
+            "peer clock offset above 2s (peerinfo exchange)",
+            lambda m, md: m.max("app_peerinfo_clock_offset_abs") > 2.0,
+            SEVERITY_WARNING,
+        ),
+        Check(
+            "pending_validators",
+            "validators pending activation",
+            lambda m, md: m.max("core_scheduler_validators_pending") > 0,
+            SEVERITY_INFO,
         ),
     ]
 
 
 class HealthChecker:
-    def __init__(self, store: MetricStore, checks: list[Check]) -> None:
+    def __init__(
+        self,
+        store: MetricStore,
+        checks: list[Check] | None = None,
+        metadata: Metadata | None = None,
+    ) -> None:
         self.store = store
-        self.checks = checks
+        self.checks = checks if checks is not None else default_checks()
+        self.metadata = metadata or Metadata()
 
     def evaluate(self) -> dict[str, bool]:
         """check name -> failing?"""
-        return {c.name: c.failing(self.store) for c in self.checks}
+        return {
+            c.name: c.failing(self.store, self.metadata)
+            for c in self.checks
+        }
+
+    def failing(self) -> list[Check]:
+        return [c for c in self.checks if c.failing(self.store, self.metadata)]
 
     def healthy(self) -> bool:
-        return not any(self.evaluate().values())
+        """Readiness gate: only CRITICAL checks flip readiness
+        (ref: severity semantics, checks.go)."""
+        return not any(
+            c.severity == SEVERITY_CRITICAL for c in self.failing()
+        )

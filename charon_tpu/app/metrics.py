@@ -103,6 +103,18 @@ class ClusterMetrics:
             registry=self.registry,
             buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
         )
+        self.plane_flushes = counter(
+            "tpu_plane_flushes_total",
+            "Crypto-plane coalescer flushes (device program launches)",
+        )
+        self.plane_coalesced = counter(
+            "tpu_plane_coalesced_flushes_total",
+            "Flushes that merged work from >= 2 concurrent submissions",
+        )
+        self.plane_lanes = counter(
+            "tpu_plane_lanes_total",
+            "Crypto lanes executed through the coalesced plane",
+        )
 
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)
@@ -192,7 +204,18 @@ async def serve_monitoring(
                 ready = ready_fn() if ready_fn else True
                 healthy = health_checker.healthy() if health_checker else True
                 ok = ready and healthy
-                body = b"ok" if ok else b"not ready"
+                if ok:
+                    body = b"ok"
+                else:
+                    # name every failing check with its severity so the
+                    # operator sees WHY (ref: monitoringapi readyz errors)
+                    lines = ["not ready"]
+                    if health_checker is not None:
+                        lines += [
+                            f"{c.severity}: {c.name} - {c.description}"
+                            for c in health_checker.failing()
+                        ]
+                    body = "\n".join(lines).encode()
                 ctype = b"text/plain"
                 status = b"200 OK" if ok else b"503 Service Unavailable"
             else:

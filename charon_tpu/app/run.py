@@ -38,7 +38,7 @@ from charon_tpu.core.parsigex import DutyGater, Eth2Verifier, ParSigEx
 from charon_tpu.core.scheduler import Scheduler
 from charon_tpu.core.sigagg import SigAgg
 from charon_tpu.core.tracker import Tracker, tracking
-from charon_tpu.core.types import PubKey, pubkey_from_bytes
+from charon_tpu.core.types import DutyType, PubKey, pubkey_from_bytes
 from charon_tpu.core.validatorapi import ValidatorAPI
 from charon_tpu.core.vapi_http import VapiRouter
 from charon_tpu.core.wire import wire
@@ -68,6 +68,11 @@ class Config:
     slots_per_epoch: int = 32
     genesis_time: float | None = None
     use_tpu_tbls: bool = True
+    # sharded crypto plane over the visible device mesh: "auto" installs
+    # it when >= 2 devices are visible (single-chip keeps the cheaper
+    # single-device TPUImpl path), "on" forces it, "off" disables
+    crypto_plane: str = "auto"
+    crypto_plane_window: float = 0.02  # coalescing window, seconds
 
 
 @dataclass
@@ -99,10 +104,32 @@ async def build_node(config: Config) -> Node:
     t = lock.definition.threshold
     share_idx = config.node_index + 1
 
+    crypto_plane = None
     if config.use_tpu_tbls:
         from charon_tpu.tbls.tpu_impl import TPUImpl
 
         tbls.set_implementation(TPUImpl())
+        if config.crypto_plane != "off":
+            import jax
+
+            n_devices = len(jax.devices())
+            if config.crypto_plane == "on" or n_devices >= 2:
+                # route the core workflow's batch crypto through the
+                # sharded slot plane: one coalesced device program per
+                # window across ALL concurrent duties (SURVEY §7 step 4)
+                from charon_tpu.core.cryptoplane import SlotCoalescer
+                from charon_tpu.parallel import SlotCryptoPlane, make_mesh
+
+                crypto_plane = SlotCoalescer(
+                    SlotCryptoPlane(make_mesh(jax.devices()), t=t),
+                    window=config.crypto_plane_window,
+                )
+                log.info(
+                    "crypto plane installed",
+                    topic="app",
+                    devices=n_devices,
+                    window=config.crypto_plane_window,
+                )
     else:
         # host path: prefer the native C++ backend — pure-Python pairing
         # (~0.3 s/verify) stalls the event loop for whole slots
@@ -144,6 +171,15 @@ async def build_node(config: Config) -> Node:
         cluster_name=lock.definition.name,
         peer=f"node{config.node_index}",
     )
+    if crypto_plane is not None:
+
+        def _plane_metrics(jobs: int, lanes: int) -> None:
+            metrics.labels(metrics.plane_flushes).inc()
+            if jobs >= 2:
+                metrics.labels(metrics.plane_coalesced).inc()
+            metrics.labels(metrics.plane_lanes).inc(lanes)
+
+        crypto_plane.metrics_hook = _plane_metrics
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -274,7 +310,11 @@ async def build_node(config: Config) -> Node:
     dutydb = DutyDB()
     parsigdb = ParSigDB(threshold=t)
     sigagg = SigAgg(
-        threshold=t, fork=fork, slots_per_epoch=config.slots_per_epoch
+        threshold=t,
+        fork=fork,
+        slots_per_epoch=config.slots_per_epoch,
+        plane=crypto_plane,
+        pubshares_by_idx=pubshares_by_idx if crypto_plane else None,
     )
     aggsigdb = AggSigDB()
     bcast = Broadcaster(beacon=beacon, clock=clock)
@@ -299,8 +339,11 @@ async def build_node(config: Config) -> Node:
         pubshares=pubshares_by_idx[share_idx],
         fork=fork,
         slots_per_epoch=config.slots_per_epoch,
+        plane=crypto_plane,
     )
-    verifier = Eth2Verifier(fork, pubshares_by_idx, config.slots_per_epoch)
+    verifier = Eth2Verifier(
+        fork, pubshares_by_idx, config.slots_per_epoch, plane=crypto_plane
+    )
     parsigex = ParSigEx(
         share_idx, parsig_transport, verifier, gater=duty_gater
     )
@@ -362,6 +405,41 @@ async def build_node(config: Config) -> Node:
     # recaster: re-broadcast VC + lock-file registrations once per epoch
     # (ref: app/app.go:676-743 wireRecaster subscribes to slots)
     scheduler.subscribe_slots(bcast.recast)
+
+    # priority/infosync: negotiate the cluster-wide protocol preference
+    # at each epoch edge over the p2p mesh and switch the consensus
+    # implementation to the cluster's top choice (ref: core/priority +
+    # core/infosync, wiring app/app.go:610-668)
+    if p2p_node is not None:
+        from charon_tpu.core.priority import (
+            InfoSync,
+            P2PPriorityExchange,
+            Prioritiser,
+            protocol_switcher,
+        )
+
+        from charon_tpu.app import version as version_mod
+
+        prio_exchange = P2PPriorityExchange(p2p_node)
+        prioritiser = Prioritiser(
+            # the scheduler never emits INFO_SYNC, so the Prioritiser
+            # itself registers its duty for expiry — consensus instance,
+            # tracker events, and stores all trim on the normal path
+            on_duty_done=deadliner.add,
+            node_idx=share_idx,
+            quorum=t,
+            exchange=prio_exchange.exchange,
+            consensus=consensus,
+            topics_fn=lambda: {
+                InfoSync.TOPIC_PROTOCOL: [
+                    p.protocol_id for p in consensus.registered()
+                ],
+                InfoSync.TOPIC_VERSION: [version_mod.VERSION],
+            },
+        )
+        prioritiser.subscribe(protocol_switcher(consensus))
+        infosync = InfoSync(prioritiser)
+        scheduler.subscribe_slots(infosync.on_slot)
 
     # inclusion checker: broadcast duties must land on-chain within 32
     # slots (ref: core/tracker/inclusion.go, wiring app/app.go:746-780)
@@ -448,6 +526,73 @@ async def build_node(config: Config) -> Node:
 
     life.register_stop(Order.SCHEDULER, "scheduler", stop_sched)
 
+    # health: the reference catalogue evaluated over this node's own
+    # sampled metrics, gating /readyz (ref: app/health + monitoringapi)
+    from charon_tpu.app import log as app_log
+    from charon_tpu.app.health import HealthChecker, Metadata, MetricStore
+
+    health_store = MetricStore()
+    health = HealthChecker(
+        health_store,
+        metadata=Metadata(num_validators=len(lock.validators), quorum=t),
+    )
+
+    async def _sample_health_loop(interval: float = 30.0):
+        import asyncio as _asyncio
+
+        while True:
+            try:
+                health_store.sample(
+                    "app_log_errors", sum(app_log.error_counts.values())
+                )
+                health_store.sample(
+                    "app_log_warnings", sum(app_log.warn_counts.values())
+                )
+                if p2p_node is not None:
+                    health_store.sample(
+                        "p2p_peers_connected",
+                        sum(
+                            1
+                            for ok in p2p_node.ping_success.values()
+                            if ok
+                        ),
+                    )
+                else:  # in-process simnet: peers are always reachable
+                    health_store.sample("p2p_peers_connected", n - 1)
+                health_store.sample(
+                    "core_tracker_failed_duties",
+                    sum(tracker.failed_total.values()),
+                )
+                health_store.sample(
+                    "core_tracker_failed_proposals",
+                    sum(
+                        cnt
+                        for (dtype, _), cnt in tracker.failed_total.items()
+                        if dtype == DutyType.PROPOSER
+                    ),
+                )
+                health_store.sample(
+                    "core_bcast_recast_errors", bcast.recast_errors
+                )
+                if p2p_node is not None and peerinfo.peers:
+                    health_store.sample(
+                        "app_peerinfo_clock_offset_abs",
+                        max(
+                            abs(p.clock_offset)
+                            for p in peerinfo.peers.values()
+                        ),
+                    )
+                try:
+                    await beacon.await_synced()
+                    health_store.sample("app_beacon_syncing", 0)
+                except Exception:  # noqa: BLE001 — syncing or unreachable
+                    health_store.sample("app_beacon_syncing", 1)
+            except Exception as e:  # noqa: BLE001 — sampling must not die
+                log.warn("health sampling failed", topic="app", err=str(e))
+            await _asyncio.sleep(interval)
+
+    life.register_start(Order.MONITORING, "health-sampler", _sample_health_loop)
+
     if config.monitoring_port:
         consensus_dump = getattr(qbft_consensus, "debug_dump", None)
 
@@ -456,6 +601,7 @@ async def build_node(config: Config) -> Node:
                 "127.0.0.1",
                 config.monitoring_port,
                 metrics,
+                health_checker=health,
                 consensus_dump=consensus_dump,
             )
 

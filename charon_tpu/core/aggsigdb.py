@@ -15,6 +15,10 @@ from charon_tpu.core.eth2data import SignedData
 from charon_tpu.core.types import Duty, PubKey
 
 
+class DutyExpiredError(Exception):
+    """The duty's deadline passed before its aggregate arrived."""
+
+
 class AggSigDB:
     def __init__(self) -> None:
         self._values: dict[tuple[Duty, PubKey], SignedData] = {}
@@ -47,6 +51,18 @@ class AggSigDB:
         return await fut
 
     def trim(self, expired: Duty) -> None:
+        """Drop stored values AND fail outstanding waiters for the duty:
+        a VC request awaiting an aggregate that never formed must error
+        at duty expiry, not hang until its HTTP timeout (ref: the
+        deadliner trim path errors queued queries, memory_v2.go)."""
         self._values = {
             k: v for k, v in self._values.items() if k[0] != expired
         }
+        for key in [k for k in self._waiters if k[0] == expired]:
+            for fut in self._waiters.pop(key, []):
+                if not fut.done():
+                    fut.set_exception(
+                        DutyExpiredError(
+                            f"duty expired before aggregate arrived: {key[0]}"
+                        )
+                    )

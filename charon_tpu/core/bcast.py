@@ -22,6 +22,7 @@ class Broadcaster:
     def __post_init__(self) -> None:
         self.broadcast_total: dict[DutyType, int] = {}
         self.broadcast_delay: list[tuple[Duty, float]] = []
+        self.recast_errors = 0  # feeds app/health (ref: recast.go metric)
         self._registrations: dict[Duty, dict] = {}
         self._subs: list = []  # post-broadcast hooks (inclusion checker)
 
@@ -125,6 +126,7 @@ class Broadcaster:
             try:
                 await self.beacon.submit_registration(payload, signature)
             except Exception as e:  # noqa: BLE001 — log-and-continue
+                self.recast_errors += 1  # feeds app/health recast check
                 log.warn(
                     "registration recast failed",
                     topic="bcast",

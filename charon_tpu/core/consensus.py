@@ -58,6 +58,14 @@ class ConsensusController:
     def current_consensus(self):
         return self._current
 
+    def registered(self):
+        """Registered protocols, current first — the preference order
+        this node advertises in priority negotiation (ref: app/app.go
+        Protocols ordering)."""
+        return [self._current] + [
+            p for p in self._protocols.values() if p is not self._current
+        ]
+
     def set_current_for_protocol(self, protocol_id: str) -> bool:
         """Switch protocols by cluster preference (ref: app/app.go:650-668
         priority-driven switching)."""

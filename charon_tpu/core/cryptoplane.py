@@ -1,0 +1,293 @@
+"""Slot-tick coalescer: ONE sharded device program per flush for the
+whole node's concurrent crypto work.
+
+The reference executes crypto per duty per signature on the CPU as calls
+arrive (ref: core/sigagg/sigagg.go:84-122 per-pubkey ThresholdAggregate +
+verify; core/parsigex/parsigex.go:94-98 and
+core/validatorapi/validatorapi.go:1213 per-signature herumi verifies).
+A TPU inverts the economics: launching a program costs milliseconds while
+extra lanes in a launched batch cost microseconds — so the win is
+batching ACROSS concurrent duties, not just within one (SURVEY §7 step 4;
+VERDICT r3 next-step 3).
+
+SlotCoalescer is that batching point. Components submit work from the
+event loop and await results; submissions arriving within one coalescing
+window (default 20 ms — negligible against a 12 s slot, wide enough to
+catch the burst of partial-sig arrivals and duty expiries a slot tick
+produces) are merged:
+
+  * verify lanes (pk, root, sig) from ParSigEx inbound sets, the
+    ValidatorAPI's pubshare checks, and SigAgg — concatenated into one
+    sharded RLC verify (`SlotCryptoPlane.verify_host`);
+  * threshold recombination jobs [V, t] from SigAgg — concatenated along
+    the validator axis into one sharded recombine+verify step
+    (`SlotCryptoPlane.recombine_host`).
+
+Device programs run on a worker thread so the event loop keeps serving
+QBFT/p2p traffic while the accelerator works. Decode failures (malformed
+compressed points) never reach the device: those lanes fail on host and
+are replaced by lane-0 padding in the batch.
+
+The plane object only needs `t`, `verify_host`, and `recombine_host` —
+production passes `parallel.mesh.SlotCryptoPlane`; fast-tier tests pass
+a counting fake backed by the pure-python oracle.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from charon_tpu.crypto import g1g2
+from charon_tpu.tbls import TblsError
+
+
+@dataclass
+class _VerifyJob:
+    lanes: list  # [(pk_pt, msg_pt, sig_pt) | None] — None = host decode fail
+    fut: asyncio.Future = field(default=None)  # type: ignore[assignment]
+
+
+@dataclass
+class _RecombineJob:
+    # all rows [V][t] / [V]; lanes with decode failures are pre-failed
+    pubshares: list
+    msgs: list
+    partials: list
+    group_pks: list
+    indices: list
+    prefail: list  # [V] bool — True: fail without consulting the device
+    fut: asyncio.Future = field(default=None)  # type: ignore[assignment]
+
+
+def _decode_pubkey(pk: bytes):
+    from charon_tpu.tbls.tpu_impl import _cached_pubkey_point
+
+    return _cached_pubkey_point(pk)
+
+
+def _decode_sig(sig: bytes):
+    from charon_tpu.tbls.python_impl import sig_to_point
+
+    pt = sig_to_point(sig, subgroup_check=False)
+    if pt is None:
+        raise TblsError("infinite signature")
+    return pt
+
+
+def _msg_point(root: bytes):
+    from charon_tpu.tbls.tpu_impl import _cached_msg_point
+
+    return _cached_msg_point(root)
+
+
+class SlotCoalescer:
+    """Merges concurrent verify / recombine submissions into single
+    sharded device programs (see module docstring).
+
+    window: seconds to wait after the first submission before flushing.
+    flushes / coalesced_flushes / lanes_flushed: observability counters
+    (exported as node metrics by app/run.py).
+    """
+
+    def __init__(self, plane, window: float = 0.02, metrics_hook=None):
+        import concurrent.futures
+
+        self.plane = plane
+        self.window = window
+        self._verify_q: list[_VerifyJob] = []
+        self._recombine_q: list[_RecombineJob] = []
+        self._flush_task: asyncio.Task | None = None
+        # single-threaded: a second window can elapse while a device
+        # program is still running; its flush must QUEUE behind the
+        # first, not race it (device contention + counter integrity)
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="crypto-plane"
+        )
+        self.flushes = 0
+        self.coalesced_flushes = 0  # flushes that merged >= 2 jobs
+        self.lanes_flushed = 0
+        # called after each flush with (jobs, lanes) — thread-safe
+        # counters only (runs on the device worker thread)
+        self.metrics_hook = metrics_hook
+
+    @property
+    def t(self) -> int:
+        return self.plane.t
+
+    # -- submission APIs (event-loop side) --------------------------------
+
+    async def verify(
+        self, items: Sequence[tuple[bytes, bytes, bytes]]
+    ) -> list[bool]:
+        """Batch-verify (pubkey_bytes, signing_root, sig_bytes) lanes.
+        Returns per-lane validity; malformed encodings are False."""
+        if not items:
+            return []
+        lanes: list = []
+        for pk, root, sig in items:
+            try:
+                lanes.append(
+                    (_decode_pubkey(pk), _msg_point(root), _decode_sig(sig))
+                )
+            except (TblsError, ValueError):
+                lanes.append(None)
+        job = _VerifyJob(lanes=lanes)
+        job.fut = asyncio.get_running_loop().create_future()
+        self._verify_q.append(job)
+        self._arm()
+        return await job.fut
+
+    async def recombine(
+        self,
+        pubshares: Sequence[Sequence[bytes]],
+        roots: Sequence[bytes],
+        partials: Sequence[Sequence[bytes]],
+        group_pks: Sequence[bytes],
+        indices: Sequence[Sequence[int]],
+    ) -> tuple[list[bytes | None], list[bool]]:
+        """Threshold-recombine + verify a duty's [V, t] workload.
+        Returns ([V] group signature bytes or None, [V] ok flags)."""
+        if not roots:
+            return [], []
+        t = self.plane.t
+        ps_rows, msg_pts, sig_rows, gpk_pts, idx_rows, prefail = (
+            [], [], [], [], [], []
+        )
+        for ps_row, root, sig_row, gpk, idx_row in zip(
+            pubshares, roots, partials, group_pks, indices
+        ):
+            try:
+                if len(sig_row) != t or len(ps_row) != t or len(idx_row) != t:
+                    raise TblsError(f"need exactly t={t} partials per lane")
+                if any(i <= 0 for i in idx_row):
+                    raise TblsError("share indices are 1-based")
+                ps_rows.append([_decode_pubkey(p) for p in ps_row])
+                sig_rows.append([_decode_sig(s) for s in sig_row])
+                gpk_pts.append(_decode_pubkey(gpk))
+                msg_pts.append(_msg_point(root))
+                idx_rows.append(list(idx_row))
+                prefail.append(False)
+            except (TblsError, ValueError):
+                # placeholder row (patched to lane data below) — never
+                # consulted; the lane is failed on host
+                ps_rows.append(None)
+                sig_rows.append(None)
+                gpk_pts.append(None)
+                msg_pts.append(None)
+                idx_rows.append(None)
+                prefail.append(True)
+        job = _RecombineJob(
+            pubshares=ps_rows,
+            msgs=msg_pts,
+            partials=sig_rows,
+            group_pks=gpk_pts,
+            indices=idx_rows,
+            prefail=prefail,
+        )
+        job.fut = asyncio.get_running_loop().create_future()
+        self._recombine_q.append(job)
+        self._arm()
+        sigs_pts, oks = await job.fut
+        return (
+            [
+                g1g2.g2_to_bytes(pt) if pt is not None else None
+                for pt in sigs_pts
+            ],
+            oks,
+        )
+
+    # -- flush machinery ---------------------------------------------------
+
+    def _arm(self) -> None:
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.create_task(self._flush_after_window())
+
+    async def _flush_after_window(self) -> None:
+        await asyncio.sleep(self.window)
+        vq, self._verify_q = self._verify_q, []
+        rq, self._recombine_q = self._recombine_q, []
+        # new submissions from here on arm a fresh flush task
+        self._flush_task = None
+        if not vq and not rq:
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            vres, rres = await loop.run_in_executor(
+                self._executor, self._run_device, vq, rq
+            )
+        except Exception as e:  # noqa: BLE001 — fail all waiters, not the loop
+            for job in [*vq, *rq]:
+                if not job.fut.done():
+                    job.fut.set_exception(
+                        TblsError(f"crypto plane flush failed: {e}")
+                    )
+            return
+        for job, res in zip(vq, vres):
+            if not job.fut.done():
+                job.fut.set_result(res)
+        for job, res in zip(rq, rres):
+            if not job.fut.done():
+                job.fut.set_result(res)
+
+    # -- device side (worker thread) --------------------------------------
+
+    def _run_device(self, vq: list[_VerifyJob], rq: list[_RecombineJob]):
+        lanes_before = self.lanes_flushed
+        vres: list[list[bool]] = []
+        if vq:
+            flat: list = []
+            for job in vq:
+                flat.extend(l for l in job.lanes if l is not None)
+            if flat:
+                pks, msgs, sigs = zip(*flat)
+                oks = iter(self.plane.verify_host(pks, msgs, sigs))
+            else:
+                oks = iter(())
+            for job in vq:
+                vres.append(
+                    [
+                        next(oks) if l is not None else False
+                        for l in job.lanes
+                    ]
+                )
+            self.lanes_flushed += len(flat)
+        rres: list[tuple[list, list[bool]]] = []
+        if rq:
+            ps, msg, sig, gpk, idx = [], [], [], [], []
+            for job in rq:
+                for i in range(len(job.msgs)):
+                    if not job.prefail[i]:
+                        ps.append(job.pubshares[i])
+                        msg.append(job.msgs[i])
+                        sig.append(job.partials[i])
+                        gpk.append(job.group_pks[i])
+                        idx.append(job.indices[i])
+            if msg:
+                out_sigs, out_oks = self.plane.recombine_host(
+                    ps, msg, sig, gpk, idx
+                )
+            else:
+                out_sigs, out_oks = [], []
+            it_sig, it_ok = iter(out_sigs), iter(out_oks)
+            for job in rq:
+                sigs_pts: list = []
+                oks: list[bool] = []
+                for pf in job.prefail:
+                    if pf:
+                        sigs_pts.append(None)
+                        oks.append(False)
+                    else:
+                        sigs_pts.append(next(it_sig))
+                        oks.append(next(it_ok))
+                rres.append((sigs_pts, oks))
+            self.lanes_flushed += len(msg)
+        self.flushes += 1
+        if len(vq) + len(rq) >= 2:
+            self.coalesced_flushes += 1
+        if self.metrics_hook is not None:
+            self.metrics_hook(
+                len(vq) + len(rq), self.lanes_flushed - lanes_before
+            )
+        return vres, rres

@@ -68,6 +68,12 @@ class DutyDB:
 
     async def store(self, duty: Duty, unsigned_set: dict[PubKey, object]) -> None:
         """Store consensus output (ref: core/dutydb/memory.go:70 Store)."""
+        if duty.type == DutyType.INFO_SYNC:
+            # protocol-internal negotiation result, not VC duty data —
+            # consumed by the Prioritiser's own decided-subscriber
+            # (ref: infosync runs a dedicated consensus instance whose
+            # output never reaches the dutydb)
+            return
         for pubkey, unsigned in unsigned_set.items():
             self._check_unique(duty, pubkey, unsigned)
             if duty.type == DutyType.ATTESTER:

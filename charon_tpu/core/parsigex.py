@@ -74,22 +74,38 @@ class Eth2Verifier:
         fork: ForkInfo,
         pubshares_by_idx: dict[int, dict[PubKey, bytes]],
         slots_per_epoch: int = 32,
+        plane: object | None = None,  # core.cryptoplane.SlotCoalescer
     ) -> None:
         self.fork = fork
         self.pubshares_by_idx = pubshares_by_idx
         self.slots_per_epoch = slots_per_epoch
+        self.plane = plane
 
-    def verify(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> bool:
+    def _items(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]):
         items = []
         for pubkey, psig in signed_set.items():
             shares = self.pubshares_by_idx.get(psig.share_idx)
             if shares is None or pubkey not in shares:
-                return False
+                return None
             root = psig.data.signing_root(
                 self.fork, duty.slot // self.slots_per_epoch
             )
             items.append((shares[pubkey], root, psig.data.signature))
-        return all(tbls.verify_batch(items))
+        return items
+
+    def verify(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> bool:
+        items = self._items(duty, signed_set)
+        return items is not None and all(tbls.verify_batch(items))
+
+    async def verify_async(
+        self, duty: Duty, signed_set: dict[PubKey, ParSignedData]
+    ) -> bool:
+        """Plane path: inbound sets from all peers land within one
+        coalescing window and verify as ONE sharded device program."""
+        if self.plane is None:
+            return self.verify(duty, signed_set)
+        items = self._items(duty, signed_set)
+        return items is not None and all(await self.plane.verify(items))
 
 
 class MemTransport:
@@ -137,7 +153,14 @@ class ParSigEx:
         if self.gater is not None and not self.gater(duty):
             self.dropped_stale += 1
             return
-        if self.verifier is not None and not self.verifier.verify(duty, signed_set):
-            return  # drop invalid sets (logged/tracked in the full stack)
+        if self.verifier is not None:
+            check = getattr(self.verifier, "verify_async", None)
+            ok = (
+                await check(duty, signed_set)
+                if check is not None
+                else self.verifier.verify(duty, signed_set)
+            )
+            if not ok:
+                return  # drop invalid sets (logged/tracked in the full stack)
         for sub in self._subs:
             await sub(duty, signed_set)

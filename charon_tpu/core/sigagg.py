@@ -26,11 +26,20 @@ class AggregationError(Exception):
 
 @dataclass
 class SigAgg:
-    """threshold: cluster threshold t; fork/epoch context for signing roots."""
+    """threshold: cluster threshold t; fork/epoch context for signing roots.
+
+    plane + pubshares_by_idx (both or neither): route recombination AND
+    group verification through the core.cryptoplane.SlotCoalescer — one
+    sharded device program per coalescing window, merged with every other
+    duty's concurrent work. Without a plane, the tbls batch API executes
+    this duty's batch alone (still one program per duty, the round-2
+    design)."""
 
     threshold: int
     fork: ForkInfo
     slots_per_epoch: int = 32
+    plane: object | None = None  # core.cryptoplane.SlotCoalescer
+    pubshares_by_idx: Mapping[int, Mapping[PubKey, bytes]] | None = None
 
     def __post_init__(self) -> None:
         self._subs: list[AggSub] = []
@@ -60,6 +69,25 @@ class SigAgg:
             )
             templates.append(use[0])
 
+        if self.plane is not None and self.pubshares_by_idx is not None:
+            group_sigs = await self._aggregate_via_plane(
+                duty, epoch, pubkeys, partial_maps, templates
+            )
+        else:
+            group_sigs = self._aggregate_via_tbls(
+                epoch, pubkeys, partial_maps, templates
+            )
+
+        out = {
+            pk: tmpl.data.with_signature(sig)
+            for pk, tmpl, sig in zip(pubkeys, templates, group_sigs)
+        }
+        for sub in self._subs:
+            await sub(duty, out)
+
+    def _aggregate_via_tbls(
+        self, epoch, pubkeys, partial_maps, templates
+    ) -> list[bytes]:
         # ONE device program recombines every pubkey's partials
         # (ref equivalent: sigagg.go:104 per-pubkey tbls.ThresholdAggregate).
         group_sigs = tbls.threshold_aggregate_batch(partial_maps)
@@ -76,10 +104,36 @@ class SigAgg:
             raise AggregationError(
                 f"recovered group signature failed verification for {bad}"
             )
+        return group_sigs
 
-        out = {
-            pk: tmpl.data.with_signature(sig)
-            for pk, tmpl, sig in zip(pubkeys, templates, group_sigs)
-        }
-        for sub in self._subs:
-            await sub(duty, out)
+    async def _aggregate_via_plane(
+        self, duty, epoch, pubkeys, partial_maps, templates
+    ) -> list[bytes]:
+        # One [V, t] recombine+verify job; the coalescer merges it with
+        # any other duty's job in the same window into ONE sharded
+        # program (recombination, per-partial verify against pubshares,
+        # and group-sig verify all inside — SlotCryptoPlane.local_step).
+        ps_rows, roots, sig_rows, gpks, idx_rows = [], [], [], [], []
+        for pubkey, template, pmap in zip(pubkeys, templates, partial_maps):
+            idx = sorted(pmap)
+            try:
+                ps_rows.append(
+                    [self.pubshares_by_idx[i][pubkey] for i in idx]
+                )
+            except KeyError as e:
+                raise AggregationError(
+                    f"no pubshare for {pubkey} share {e}"
+                ) from e
+            roots.append(template.data.signing_root(self.fork, epoch))
+            sig_rows.append([pmap[i] for i in idx])
+            gpks.append(pubkey_to_bytes(pubkey))
+            idx_rows.append(idx)
+        group_sigs, ok = await self.plane.recombine(
+            ps_rows, roots, sig_rows, gpks, idx_rows
+        )
+        bad = [str(pk) for pk, o in zip(pubkeys, ok) if not o]
+        if bad:
+            raise AggregationError(
+                f"recovered group signature failed verification for {bad}"
+            )
+        return group_sigs

@@ -141,6 +141,9 @@ _TERMINAL_STEPS = {
     DutyType.RANDAO: Step.AGG_SIG_DB,
     DutyType.PREPARE_AGGREGATOR: Step.AGG_SIG_DB,
     DutyType.PREPARE_SYNC_CONTRIBUTION: Step.AGG_SIG_DB,
+    # protocol-internal negotiation completes at consensus decision
+    # (its value never enters the signing pipeline)
+    DutyType.INFO_SYNC: Step.CONSENSUS,
 }
 
 # Duties whose fetch depends on a prerequisite duty in the same slot

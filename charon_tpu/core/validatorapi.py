@@ -42,6 +42,9 @@ class ValidatorAPI:
     pubshares: dict[PubKey, bytes]
     fork: ForkInfo
     slots_per_epoch: int = 32
+    # optional core.cryptoplane.SlotCoalescer: partial-sig pubshare checks
+    # from concurrent VC submissions merge into one sharded device program
+    plane: object | None = None
 
     def __post_init__(self) -> None:
         self._subs: list = []
@@ -119,7 +122,7 @@ class ValidatorAPI:
             signed = SignedData("attestation", att, att.signature)
             items.append(self._verify_item(pubkey, signed, slot))
             metas.append((Duty(slot, DutyType.ATTESTER), pubkey, signed))
-        self._check_batch(items)
+        await self._check_batch(items)
         for duty, pubkey, signed in metas:
             by_duty.setdefault(duty, {})[pubkey] = ParSignedData(
                 data=signed, share_idx=self.share_idx
@@ -130,7 +133,7 @@ class ValidatorAPI:
 
     async def submit_proposal(self, pubkey: PubKey, proposal: Proposal, signature: bytes) -> None:
         signed = SignedData("block", proposal, signature)
-        self._check_batch([self._verify_item(pubkey, signed, proposal.slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, proposal.slot)])
         duty = Duty(proposal.slot, DutyType.PROPOSER)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -140,7 +143,7 @@ class ValidatorAPI:
         (ref: validatorapi.go:335 Proposal flow)."""
         epoch = slot // self.slots_per_epoch
         signed = SignedData("randao", epoch, signature)
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.RANDAO)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -149,7 +152,7 @@ class ValidatorAPI:
         """Beacon-committee selection partials
         (ref: validatorapi.go:724 AggregateBeaconCommitteeSelections)."""
         signed = SignedData("selection_proof", slot, signature)
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.PREPARE_AGGREGATOR)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -160,7 +163,7 @@ class ValidatorAPI:
 
     async def submit_aggregate_and_proof(self, pubkey: PubKey, agg, signature: bytes) -> None:
         signed = SignedData("aggregate_and_proof", agg, signature)
-        self._check_batch(
+        await self._check_batch(
             [self._verify_item(pubkey, signed, agg.aggregate.data.slot)]
         )
         duty = Duty(agg.aggregate.data.slot, DutyType.AGGREGATOR)
@@ -183,7 +186,7 @@ class ValidatorAPI:
 
         payload = SyncSelectionData(slot, subcommittee_index)
         signed = SignedData("sync_selection", payload, signature)
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -205,7 +208,7 @@ class ValidatorAPI:
     ) -> None:
         signed = SignedData("contribution_and_proof", cap, signature)
         slot = cap.contribution.slot
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.SYNC_CONTRIBUTION)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -215,7 +218,7 @@ class ValidatorAPI:
 
     async def submit_sync_message(self, slot: int, pubkey: PubKey, msg, signature: bytes) -> None:
         signed = SignedData("sync_message", msg, signature)
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.SYNC_MESSAGE)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -225,14 +228,14 @@ class ValidatorAPI:
         endpoints + cmd/exit_sign.go)."""
         signed = SignedData("exit", exit_msg, signature)
         slot = exit_msg.epoch * self.slots_per_epoch
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.EXIT)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
 
     async def submit_registration(self, pubkey: PubKey, reg, signature: bytes, slot: int = 0) -> None:
         signed = SignedData("registration", reg, signature)
-        self._check_batch([self._verify_item(pubkey, signed, slot)])
+        await self._check_batch([self._verify_item(pubkey, signed, slot)])
         duty = Duty(slot, DutyType.BUILDER_REGISTRATION)
         for sub in self._subs:
             await sub(duty, {pubkey: ParSignedData(signed, self.share_idx)})
@@ -246,9 +249,14 @@ class ValidatorAPI:
         root = signed.signing_root(self.fork, slot // self.slots_per_epoch)
         return (pubshare, root, signed.signature)
 
-    def _check_batch(self, items) -> None:
+    async def _check_batch(self, items) -> None:
         """Verify partial signatures against pubshares — batched
-        (ref: validatorapi.go:1213 one herumi call per signature)."""
-        ok = tbls.verify_batch(items)
+        (ref: validatorapi.go:1213 one herumi call per signature). With a
+        crypto plane installed, concurrent submissions coalesce into one
+        sharded device program."""
+        if self.plane is not None:
+            ok = await self.plane.verify(items)
+        else:
+            ok = tbls.verify_batch(items)
         if not all(ok):
             raise VapiError("partial signature failed pubshare verification")

@@ -167,5 +167,12 @@ def _register_core_types() -> None:
     register_enum(DutyType)
     register_enum(qbft.MsgType)
 
+    # priority negotiation rides the p2p mesh and the consensus value
+    # set (ref: core/corepb PriorityMsg / PriorityTopicResult)
+    from charon_tpu.core import priority
+
+    register(priority.PriorityMsg)
+    register(priority.TopicResult)
+
 
 _register_core_types()

@@ -89,6 +89,8 @@ class SlotCryptoPlane:
         self.axis = tuple(mesh.axis_names)
         self._step = self._build()
         self._step_rlc = self._build_rlc()
+        self._verify = self._build_verify()
+        self._verify_rlc = self._build_verify_rlc()
 
     def _build(self):
         ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
@@ -192,6 +194,46 @@ class SlotCryptoPlane:
         )
         return jax.jit(sharded)
 
+    def _build_verify(self):
+        """Plain per-lane sharded verify: ok[N] — the attribution path
+        (each lane pays its own final exponentiation; used only when the
+        RLC fast path says the batch contains a failure)."""
+        ctx, axis = self.ctx, self.axis
+
+        def local(pk, msg, sig, live):
+            ok = DP.batched_verify(ctx, pk, msg, sig)
+            return jnp.logical_and(ok, live)
+
+        sharded = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P(axis),
+        )
+        return jax.jit(sharded)
+
+    def _build_verify_rlc(self):
+        """Sharded whole-batch RLC verify: every shard product-trees its
+        lanes under independent 64-bit exponents and runs ONE local final
+        exponentiation; the cross-device op is a scalar psum of failure
+        counts. Padding lanes (live=False) get exponent 0 so their
+        pairing values contribute ^0 = 1."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local(pk, msg, sig, live, rand):
+            rand = jnp.where(live[:, None], rand, 0)
+            ok = DP.batched_verify_rlc(ctx, fr_ctx, pk, msg, sig, rand)
+            bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
+            return bad == 0
+
+        sharded = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=P(),
+        )
+        return jax.jit(sharded)
+
     def step_rlc(self, pubshares, msg, partials, group_pk, indices, live, rand):
         """Fast path: (group_sig, all_ok). `rand` is a [V, t+1] raw Fr
         limb array of independent nonzero 64-bit exponents (host
@@ -275,4 +317,81 @@ class SlotCryptoPlane:
             C.g2_unpack(self.ctx, group_sig)[:v],
             [bool(b) for b in np.asarray(ok)[:v]],
             int(total),
+        )
+
+    # -- coalescer-facing host API ----------------------------------------
+    # (core/cryptoplane.SlotCoalescer talks to the plane exclusively
+    # through recombine_host / verify_host so a counting fake can stand
+    # in for the device in fast-tier tests)
+
+    def pack_verify_inputs(self, pks, msgs, sigs):
+        """Python-int affine points -> [N] device arrays + live mask,
+        N padded up to the mesh size by repeating lane 0."""
+        n = len(pks)
+        shards = self.shard_count()
+        pad = (-n) % shards
+        if pad:
+            pks = list(pks) + [pks[0]] * pad
+            msgs = list(msgs) + [msgs[0]] * pad
+            sigs = list(sigs) + [sigs[0]] * pad
+        pk = C.g1_pack(self.ctx, pks)
+        msg = C.g2_pack(self.ctx, msgs)
+        sig = C.g2_pack(self.ctx, sigs)
+        live = jnp.asarray(np.arange(n + pad) < n)
+        return pk, msg, sig, live
+
+    def make_lane_rand(self, n: int, rng=None) -> jnp.ndarray:
+        """[N_padded] independent nonzero 64-bit exponents as raw Fr
+        limbs (see make_rand for the randomness contract)."""
+        import random as _random
+
+        rng = rng or _random.SystemRandom()
+        np_ = n + ((-n) % self.shard_count())
+        return jnp.asarray(
+            np.asarray(
+                [
+                    limb.int_to_limbs(
+                        rng.randrange(1, 1 << 64),
+                        self.fr_ctx.n_limbs,
+                        self.fr_ctx.limb_bits,
+                        self.fr_ctx.np_dtype,
+                    )
+                    for _ in range(np_)
+                ]
+            )
+        )
+
+    def verify_host(self, pks, msgs, sigs, rng=None) -> list[bool]:
+        """Sharded batch verify of N independent (pk, msg, sig) lanes.
+        RLC fast path first (one shared final-exp per shard); only a
+        failing batch pays the per-lane attribution program."""
+        n = len(pks)
+        if n == 0:
+            return []
+        pk, msg, sig, live = self.pack_verify_inputs(pks, msgs, sigs)
+        rand = self.make_lane_rand(n, rng=rng)
+        if bool(self._verify_rlc(pk, msg, sig, live, rand)):
+            return [True] * n
+        ok = self._verify(pk, msg, sig, live)
+        return [bool(b) for b in np.asarray(ok)[:n]]
+
+    def recombine_host(
+        self, pubshares, msgs, partials, group_pks, indices, rng=None
+    ):
+        """Recombine + verify [V, t] threshold workloads in one sharded
+        program: returns ([V] group signature points, [V] ok flags).
+        RLC fast path first; a failing batch re-runs the per-lane step
+        for attribution."""
+        v = len(msgs)
+        if v == 0:
+            return [], []
+        args = self.pack_inputs(pubshares, msgs, partials, group_pks, indices)
+        rand = self.make_rand(v, rng=rng)
+        group_sig, all_ok = self.step_rlc(*args, rand)
+        if bool(all_ok):
+            return C.g2_unpack(self.ctx, group_sig)[:v], [True] * v
+        group_sig, ok, _total = self.step(*args)
+        return (
+            C.g2_unpack(self.ctx, group_sig)[:v],
+            [bool(b) for b in np.asarray(ok)[:v]],
         )

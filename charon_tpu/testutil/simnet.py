@@ -22,6 +22,7 @@ from charon_tpu.core.parsigdb import ParSigDB
 from charon_tpu.core.parsigex import Eth2Verifier, MemTransport, ParSigEx
 from charon_tpu.core.scheduler import Scheduler
 from charon_tpu.core.sigagg import SigAgg
+from charon_tpu.core.tracker import Tracker, tracking
 from charon_tpu.core.types import PubKey, pubkey_from_bytes
 from charon_tpu.core.validatorapi import ValidatorAPI
 from charon_tpu.core.wire import wire
@@ -61,6 +62,7 @@ class SimNode:
     bcast: Broadcaster
     consensus: ConsensusController
     inclusion: InclusionChecker | None = None
+    tracker: Tracker | None = None
 
 
 def build_cluster(
@@ -72,6 +74,7 @@ def build_cluster(
     genesis_time: float | None = None,
     use_qbft: bool = False,
     wire_vmock: bool = True,
+    protocol_prefs: list[list[str]] | None = None,
 ) -> SimCluster:
     """Create keys and wire n in-process nodes (ref: app/app.go simnet +
     cluster/test_cluster.go generator, redesigned for asyncio)."""
@@ -119,10 +122,26 @@ def build_cluster(
         from charon_tpu.core.consensus_qbft import MemMsgNet
 
         qbft_net = MemMsgNet()
+    # priority negotiation fabric (opt-in: protocol_prefs per node)
+    prio_fabric = None
+    if protocol_prefs is not None:
+        from charon_tpu.core.priority import MemPriorityFabric
+
+        assert len(protocol_prefs) == n
+        prio_fabric = MemPriorityFabric()
     for i in range(1, n + 1):
         cluster.nodes.append(
             _build_node(
-                cluster, i, transport, slots_per_epoch, qbft_net, wire_vmock
+                cluster,
+                i,
+                transport,
+                slots_per_epoch,
+                qbft_net,
+                wire_vmock,
+                prio_fabric=prio_fabric,
+                protocol_prefs=(
+                    protocol_prefs[i - 1] if protocol_prefs else None
+                ),
             )
         )
     return cluster
@@ -135,6 +154,8 @@ def _build_node(
     spe: int,
     qbft_net=None,
     wire_vmock: bool = True,
+    prio_fabric=None,
+    protocol_prefs: list[str] | None = None,
 ) -> SimNode:
     beacon = cluster.beacon
     fork = cluster.fork
@@ -151,6 +172,9 @@ def _build_node(
         consensus = ConsensusController(
             QBFTConsensus(qbft_net, cluster.n, round_timeout=0.3)
         )
+        # echo stays registered as a switchable alternate so priority
+        # negotiation can change the protocol mid-run
+        consensus.register(EchoConsensus())
     else:
         consensus = ConsensusController(EchoConsensus())
     vapi = ValidatorAPI(
@@ -182,6 +206,10 @@ def _build_node(
     )
     spawn_fetch = with_async_retry(retryer)
 
+    # same tracker wiring as production (app/run.py): every edge feeds
+    # step/participation events; tests expire duties to get reports
+    tracker = Tracker(peer_share_indices=list(range(1, cluster.n + 1)))
+
     wire(
         scheduler=scheduler,
         fetcher=fetcher,
@@ -193,7 +221,7 @@ def _build_node(
         sigagg=sigagg,
         aggsigdb=aggsigdb,
         broadcaster=bcast,
-        options=[spawn_fetch],
+        options=[tracking(tracker), spawn_fetch],
     )
     # fetcher pulls the aggregated randao from aggsigdb
     fetcher.register_agg_sig_db(aggsigdb.await_)
@@ -227,6 +255,28 @@ def _build_node(
     bcast.subscribe(inclusion.submitted)
     scheduler.subscribe_slots(inclusion.on_slot)
 
+    # priority/infosync negotiation at epoch edges, switching the
+    # consensus protocol to the cluster choice (same wiring as
+    # app/run.py; ref: core/priority + core/infosync)
+    if prio_fabric is not None and protocol_prefs is not None:
+        from charon_tpu.core.priority import (
+            InfoSync,
+            Prioritiser,
+            protocol_switcher,
+        )
+
+        prio_fabric.join()
+        prioritiser = Prioritiser(
+            node_idx=share_idx,
+            quorum=cluster.t,
+            exchange=prio_fabric.exchange,
+            consensus=consensus,
+            topics_fn=lambda: {InfoSync.TOPIC_PROTOCOL: protocol_prefs},
+        )
+        prioritiser.subscribe(protocol_switcher(consensus))
+        infosync = InfoSync(prioritiser)
+        scheduler.subscribe_slots(infosync.on_slot)
+
     return SimNode(
         share_idx=share_idx,
         scheduler=scheduler,
@@ -239,4 +289,5 @@ def _build_node(
         bcast=bcast,
         consensus=consensus,
         inclusion=inclusion,
+        tracker=tracker,
     )

@@ -88,13 +88,25 @@ def test_featureset_statuses():
 
 
 def test_health_checks():
+    from charon_tpu.app.health import SEVERITY_CRITICAL
+
     now = [0.0]
     store = MetricStore(now=lambda: now[0])
     checker = HealthChecker(
         store,
         [
-            Check("errors", "err spike", lambda m: m.increase("errs") > 10),
-            Check("peers", "low peers", lambda m: m.latest("peers", 0) < 2),
+            Check(
+                "errors",
+                "err spike",
+                lambda m, md: m.increase("errs") > 10,
+                SEVERITY_CRITICAL,
+            ),
+            Check(
+                "peers",
+                "low peers",
+                lambda m, md: m.latest("peers", 0) < 2,
+                SEVERITY_CRITICAL,
+            ),
         ],
     )
     store.sample("errs", 0)
@@ -104,6 +116,64 @@ def test_health_checks():
     store.sample("errs", 20)  # +20 errors in window
     assert checker.evaluate() == {"errors": True, "peers": False}
     assert not checker.healthy()
+
+
+def test_health_catalogue_and_severities():
+    """The reference catalogue (ref: health/checks.go:41-151): scaled
+    log-rate thresholds, critical-vs-warning readiness semantics, clock
+    skew from peerinfo."""
+    from charon_tpu.app.health import Metadata, default_checks
+
+    now = [0.0]
+    store = MetricStore(now=lambda: now[0])
+    checker = HealthChecker(store, metadata=Metadata(num_validators=2, quorum=3))
+    assert {c.name for c in checker.checks} == {
+        "high_error_log_rate",
+        "high_warning_log_rate",
+        "beacon_node_syncing",
+        "insufficient_connected_peers",
+        "proposal_failures",
+        "failed_duties",
+        "high_registration_failures_rate",
+        "high_clock_skew",
+        "pending_validators",
+    }
+    # seed a healthy baseline
+    store.sample("app_log_errors", 0)
+    store.sample("app_log_warnings", 0)
+    store.sample("app_beacon_syncing", 0)
+    store.sample("p2p_peers_connected", 3)
+    store.sample("core_tracker_failed_duties", 0)
+    store.sample("core_tracker_failed_proposals", 0)
+    store.sample("core_bcast_recast_errors", 0)
+    store.sample("app_peerinfo_clock_offset_abs", 0.1)
+    assert checker.healthy()
+    assert not checker.failing()
+
+    # 2 validators allow 4 errors per window; 5 trips the warning but
+    # NOT readiness (severity=warning)
+    now[0] = 60
+    store.sample("app_log_errors", 5)
+    assert checker.evaluate()["high_error_log_rate"]
+    assert checker.healthy()
+
+    # a transient peer dip does NOT trip the check: gaugeMax over the
+    # window still sees the healthy count (ref: checker.go gaugeMax)
+    store.sample("p2p_peers_connected", 1)
+    assert checker.healthy()
+    # a SUSTAINED loss does: once healthy samples age out of the window,
+    # the max drops below quorum-1 and readiness flips (critical)
+    now[0] = 700
+    store.sample("p2p_peers_connected", 1)
+    assert checker.evaluate()["insufficient_connected_peers"]
+    assert not checker.healthy()
+    store.sample("p2p_peers_connected", 3)
+    assert checker.healthy()
+
+    # clock skew beyond 2s warns
+    store.sample("app_peerinfo_clock_offset_abs", 3.5)
+    assert checker.evaluate()["high_clock_skew"]
+    assert checker.healthy()  # warning severity
 
 
 def test_metrics_endpoint():

@@ -287,3 +287,28 @@ def test_aggsigdb_store_await():
         assert got.signature == data.signature
 
     asyncio.run(run())
+
+
+def test_aggsigdb_waiters_fail_at_expiry():
+    """A waiter for an aggregate that never arrives is FAILED when the
+    deadliner trims the duty, instead of hanging until HTTP timeout
+    (VERDICT r3 weak #6; ref: aggsigdb memory_v2 trim errors queries)."""
+    from charon_tpu.core.aggsigdb import AggSigDB, DutyExpiredError
+
+    async def run():
+        db = AggSigDB()
+        duty = Duty(5, DutyType.RANDAO)
+        pk = PubKey("0x" + "ab" * 48)
+        waiter = asyncio.create_task(db.await_(duty, pk))
+        await asyncio.sleep(0)  # let the waiter register
+        db.trim(duty)
+        with pytest.raises(DutyExpiredError):
+            await asyncio.wait_for(waiter, timeout=5)
+        # an unrelated duty's waiter is untouched
+        other = asyncio.create_task(db.await_(Duty(6, DutyType.RANDAO), pk))
+        await asyncio.sleep(0)
+        db.trim(duty)
+        assert not other.done()
+        other.cancel()
+
+    asyncio.run(run())

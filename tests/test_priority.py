@@ -16,10 +16,10 @@ from charon_tpu.core.priority import (
 from charon_tpu.core.scheduler import Slot
 
 
-def msg(idx, **topics):
+def msg(idx, slot=10, **topics):
     return PriorityMsg(
         peer_idx=idx,
-        slot=10,
+        slot=slot,
         topics=tuple((t, tuple(v)) for t, v in sorted(topics.items())),
     )
 
@@ -80,16 +80,22 @@ def test_prioritiser_and_switcher_end_to_end():
         prior.subscribe(lambda slot, res: results.append(res) or _noop())
         prior.subscribe(protocol_switcher(controller))
 
-        # seed peers' messages (as if already exchanged)
+        # seed peers' messages (as if already exchanged) — same slot as
+        # the negotiation round (validate_msgs rejects mismatched slots).
+        # qbft has 3 supporters vs echo's 2: count-first scoring
+        # (ref: calculate.go countWeight) puts qbft on top
         store[1] = msg(
-            1, **{InfoSync.TOPIC_PROTOCOL: ["qbft/2.0.0", "echo/1.0.0"]}
+            1, slot=7, **{InfoSync.TOPIC_PROTOCOL: ["qbft/2.0.0", "echo/1.0.0"]}
         )
-        store[2] = msg(2, **{InfoSync.TOPIC_PROTOCOL: ["echo/1.0.0"]})
+        store[2] = msg(2, slot=7, **{InfoSync.TOPIC_PROTOCOL: ["qbft/2.0.0"]})
 
         info = InfoSync(prior)
         slot = Slot(slot=7, time=0, slot_duration=1, slots_per_epoch=8)
         assert slot.is_last_in_epoch()
         await info.on_slot(slot)
+        # negotiation runs as a background task so the scheduler's slot
+        # handling is never delayed — join it before asserting
+        await info._task
 
         assert results, "no priority result delivered"
         assert results[0][0].priorities[0] == "qbft/2.0.0"
@@ -99,3 +105,46 @@ def test_prioritiser_and_switcher_end_to_end():
         return None
 
     asyncio.run(run())
+
+
+def test_calculate_count_beats_position():
+    """Count-first ordering (ref: calculate.go countWeight): a priority
+    listed LOW by three peers beats one listed top by two."""
+    msgs = [
+        msg(0, proto=["a", "c"]),
+        msg(1, proto=["a", "c"]),
+        msg(2, proto=["c"]),
+    ]
+    [result] = calculate(msgs, quorum=2)
+    assert result.priorities == ("c", "a")
+    # scores carried for observability (ref: PriorityScoredResult)
+    assert result.scores[0] > result.scores[1]
+
+
+def test_calculate_validation_rules():
+    """ref: calculate.go validateMsgs rules."""
+    from charon_tpu.core.priority import PriorityError
+
+    with pytest.raises(PriorityError, match="empty"):
+        calculate([], quorum=2)
+    with pytest.raises(PriorityError, match="slots"):
+        calculate([msg(0, slot=1, p=["a"]), msg(1, slot=2, p=["a"])], quorum=2)
+    with pytest.raises(PriorityError, match="duplicate peer"):
+        calculate([msg(0, p=["a"]), msg(0, p=["a"])], quorum=2)
+    with pytest.raises(PriorityError, match="duplicate priority"):
+        calculate([msg(0, p=["a", "a"])], quorum=1)
+    with pytest.raises(PriorityError, match="duplicate topic"):
+        calculate(
+            [
+                PriorityMsg(
+                    peer_idx=0,
+                    slot=1,
+                    topics=(("p", ("a",)), ("p", ("b",))),
+                )
+            ],
+            quorum=1,
+        )
+    with pytest.raises(PriorityError, match="max"):
+        calculate(
+            [msg(0, p=[str(i) for i in range(1000)])], quorum=1
+        )

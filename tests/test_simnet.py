@@ -147,3 +147,122 @@ def test_simnet_survives_fuzzed_beacon():
         tbls.verify(pubkey_to_bytes(group_pk), root, att.signature)
 
     asyncio.run(run())
+
+
+def test_simnet_tracker_names_silenced_node():
+    """One node's VC goes silent; the cluster still completes the duty
+    (3-of-4 threshold) and every healthy node's tracker NAMES the silent
+    share in its participation report (VERDICT r3 next-step 5; ref:
+    core/tracker/tracker.go analyseParticipation + the participation
+    metrics the reference alerts on)."""
+
+    async def run():
+        cluster = build_cluster(n=4, t=3, num_validators=1, slot_duration=0.4)
+        silenced = cluster.nodes[3]
+
+        async def silent_attest(slot, defs):
+            return None  # VC down: never submits a partial signature
+
+        silenced.vmock.attest = silent_attest
+
+        tasks = [
+            asyncio.create_task(node.scheduler.run())
+            for node in cluster.nodes
+        ]
+        beacon = cluster.beacon
+        try:
+
+            async def all_done():
+                # ALL FOUR nodes still broadcast: the silent node's peers
+                # supply threshold partials, so its own workflow completes
+                while len(beacon.attestations) < 4:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(all_done(), timeout=60)
+        finally:
+            for node in cluster.nodes:
+                node.scheduler.stop()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        from charon_tpu.core.types import Duty, DutyType
+
+        duty = Duty(beacon.attestations[0].data.slot, DutyType.ATTESTER)
+        report = await cluster.nodes[0].tracker.duty_expired(duty)
+        assert report.success
+        # shares 1-3 participated; share 4 is named absent
+        assert report.participation == {1: True, 2: True, 3: True, 4: False}
+        assert report.participation_counts.get(4, 0) == 0
+        assert report.participation_counts[1] == report.expected_per_peer == 1
+        assert not report.unexpected_shares
+        assert not report.inconsistent_pubkeys
+
+    asyncio.run(run())
+
+
+def test_simnet_priority_switches_protocol_mid_run():
+    """Nodes start with DIFFERENT protocol preferences; the epoch-edge
+    priority negotiation converges (count-first scoring) and every
+    node's consensus implementation actually switches mid-run, after
+    which duties keep completing (VERDICT r3 next-step 6; ref:
+    core/priority + core/infosync + app/app.go:650-668)."""
+
+    async def run():
+        # 3 nodes prefer echo, 1 prefers qbft -> echo wins 4:4 on count,
+        # 3999:3997 on position tie-break
+        prefs = [
+            ["echo/1.0.0", "qbft/2.0.0"],
+            ["echo/1.0.0", "qbft/2.0.0"],
+            ["echo/1.0.0", "qbft/2.0.0"],
+            ["qbft/2.0.0", "echo/1.0.0"],
+        ]
+        cluster = build_cluster(
+            n=4,
+            t=3,
+            num_validators=1,
+            slot_duration=0.4,
+            use_qbft=True,
+            protocol_prefs=prefs,
+        )
+        assert all(
+            n.consensus.current_consensus().protocol_id == "qbft/2.0.0"
+            for n in cluster.nodes
+        )
+        tasks = [
+            asyncio.create_task(node.scheduler.run())
+            for node in cluster.nodes
+        ]
+        beacon = cluster.beacon
+        try:
+
+            async def switched():
+                while not all(
+                    n.consensus.current_consensus().protocol_id
+                    == "echo/1.0.0"
+                    for n in cluster.nodes
+                ):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(switched(), timeout=60)
+            # duties still complete under the switched protocol
+            base = len(beacon.attestations)
+
+            async def progressed():
+                while len(beacon.attestations) < base + 4:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(progressed(), timeout=60)
+        finally:
+            for node in cluster.nodes:
+                node.scheduler.stop()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        # the post-switch attestations still carry valid group signatures
+        att = beacon.attestations[-1]
+        root = SignedData("attestation", att).signing_root(
+            cluster.fork, att.data.slot // beacon.slots_per_epoch
+        )
+        tbls.verify(
+            pubkey_to_bytes(cluster.group_pubkeys[0]), root, att.signature
+        )
+
+    asyncio.run(run())
