@@ -16,8 +16,9 @@ Two limb geometries are provided, selected per ModCtx:
   * 12-bit limbs in uint32 (TPU-friendly): TPUs have no native 64-bit
     integers (XLA emulates them slowly), so the TPU contexts use 12-bit
     limbs whose products fit 24 bits; a 32-term column plus Montgomery
-    additions stays < 2^31 in uint32. 12 = 4 + 8 keeps a future Pallas
-    int8-MXU decomposition aligned.
+    additions stays < 2^31 in uint32. The 12-bit width also splits into
+    two 6-bit pieces that fit SIGNED int8 — the MXU decomposition of the
+    constant-operand convolutions lives in ops/limb_mxu.py.
 
 The no-mid-loop-carry invariant (see mont_mul) is asserted in make_ctx for
 whatever geometry is requested.
@@ -495,9 +496,16 @@ def mont_mul(ctx: ModCtx, a, b):
     m = _conv_low(ctx, t[..., :n], jnp.asarray(ctx.ninv))
     m, _ = _normalize(ctx, m)  # mod R: top carry intentionally dropped
     s = t + _conv_full(ctx, m, jnp.asarray(ctx.limbs))
-    # Final conditional subtract fused into the last normalize: lane2 adds
-    # (R - p) into the high columns, so its carry-out says hi >= p — one
-    # stacked normalize replaces normalize + cond_sub.
+    return _mont_tail(ctx, s)
+
+
+def _mont_tail(ctx: ModCtx, s):
+    """Shared Montgomery tail (also used by ops/limb_mxu): s ≡ 0 mod R in
+    accumulator range -> canonical high half, with the final conditional
+    subtract fused into the last normalize — lane2 adds (R - p) into the
+    high columns, so its carry-out says hi >= p; one stacked normalize
+    replaces normalize + cond_sub."""
+    n = ctx.n_limbs
     rm_hi = jnp.zeros(2 * n, ctx.np_dtype).at[n:].set(
         jnp.asarray(_r_minus_m(ctx))
     )

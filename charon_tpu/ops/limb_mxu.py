@@ -1,0 +1,121 @@
+"""int8-MXU decomposition of 12-bit-limb Montgomery multiplication.
+
+The separated-operand mont_mul (ops/limb.py:473) spends its FLOPs in
+three limb convolutions. Two of them multiply by CONSTANT vectors —
+t * ninv (mod R) and m * p — and a convolution by a constant is a matmul
+against a fixed Toeplitz band matrix:
+
+    conv(a, c)[k] = sum_i a[i] * c[k-i]  =  (a @ T_c)[k],
+    T_c[i, k] = c[k-i]
+
+which is exactly the shape the MXU consumes, provided the entries fit
+its int8 x int8 -> int32 mode. A 12-bit limb splits into two 6-bit
+pieces (v = v1*64 + v0, both < 64): 12 = 6 + 6 rather than the 4 + 8
+split noted in ops/limb.py because the MXU multiplies SIGNED int8 — an
+8-bit piece (0..255) would need offset correction terms, while 6-bit
+pieces use the [0, 63] subrange directly. Each constant conv becomes
+four int8 matmuls (a0/a1 against T0/T1) recombined with shifts:
+
+    conv(a, c) = s00 + (s01 + s10) << 6 + s11 << 12
+
+Headroom: n=32 column terms x 63^2 <= 127,008 per partial sum, and the
+recombined column is < 2^30 — inside the uint32 accumulator range the
+existing carry normalization (limb._normalize) is built for.
+
+The data-dependent a*b product keeps the VPU band-einsum (both operands
+vary per lane, so there is no constant matrix to hit the MXU with).
+
+Cost model vs the pure-VPU path: see PERF.md "int8-MXU lever". This
+module is interpret-mode/CPU-correct today (tests/test_limb_mxu.py
+cross-checks bit-identity against mont_mul and the host bigint oracle);
+enabling it on real TPU is a dispatch flag once measured.
+
+ref analogue: none — the reference's herumi backend is scalar CPU
+assembly (tbls/herumi.go); this decomposition exists only because the
+target is a systolic array.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from charon_tpu.ops import limb
+from charon_tpu.ops.limb import ModCtx
+
+_PIECE_BITS = 6
+_PIECE_MASK = (1 << _PIECE_BITS) - 1
+
+
+def _toeplitz_pieces(c: np.ndarray, n: int, out_cols: int):
+    """Constant limb vector -> (T0, T1) int8 band matrices [n, out_cols]
+    holding the low/high 6-bit pieces of c[k-i]."""
+    T0 = np.zeros((n, out_cols), np.int8)
+    T1 = np.zeros((n, out_cols), np.int8)
+    for i in range(n):
+        for k in range(out_cols):
+            j = k - i
+            if 0 <= j < n:
+                v = int(c[j])
+                T0[i, k] = v & _PIECE_MASK
+                T1[i, k] = v >> _PIECE_BITS
+    return T0, T1
+
+
+@functools.lru_cache(maxsize=None)
+def _ninv_toeplitz(ctx: ModCtx):
+    """Low-conv (mod R) Toeplitz of -m^-1: out_cols = n."""
+    return _toeplitz_pieces(ctx.ninv, ctx.n_limbs, ctx.n_limbs)
+
+
+@functools.lru_cache(maxsize=None)
+def _modulus_toeplitz(ctx: ModCtx):
+    """Full-conv Toeplitz of the modulus: out_cols = 2n."""
+    return _toeplitz_pieces(ctx.limbs, ctx.n_limbs, 2 * ctx.n_limbs)
+
+
+def conv_const_mxu(ctx: ModCtx, a, pieces):
+    """conv(a, c) for canonical-limb `a` and a constant c given as
+    Toeplitz 6-bit pieces — four int8 matmuls on the MXU, recombined in
+    uint32 accumulator range."""
+    T0, T1 = pieces
+    a = a.astype(jnp.int32)
+    a0 = (a & _PIECE_MASK).astype(jnp.int8)
+    a1 = (a >> _PIECE_BITS).astype(jnp.int8)
+
+    def mm(x, T):
+        return lax.dot_general(
+            x,
+            jnp.asarray(T),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    s00 = mm(a0, T0)
+    s01 = mm(a0, T1)
+    s10 = mm(a1, T0)
+    s11 = mm(a1, T1)
+    return (
+        s00.astype(jnp.uint32)
+        + ((s01 + s10).astype(jnp.uint32) << _PIECE_BITS)
+        + (s11.astype(jnp.uint32) << (2 * _PIECE_BITS))
+    )
+
+
+def mont_mul_mxu(ctx: ModCtx, a, b):
+    """a * b * R^-1 mod m — same algorithm and tail as limb.mont_mul,
+    with the two constant-operand convolutions lowered to int8 MXU
+    matmuls (module docstring). Requires a 12-bit limb geometry."""
+    if ctx.limb_bits != 12:
+        raise ValueError("int8-MXU decomposition needs the 12-bit geometry")
+    a, b = jnp.broadcast_arrays(a, b)
+    n = ctx.n_limbs
+    t = limb._conv_full(ctx, a, b)  # data-dependent: stays VPU
+    t, _ = limb._normalize(ctx, t)
+    m = conv_const_mxu(ctx, t[..., :n], _ninv_toeplitz(ctx))
+    m, _ = limb._normalize(ctx, m)  # mod R: top carry intentionally dropped
+    s = t + conv_const_mxu(ctx, m, _modulus_toeplitz(ctx))
+    return limb._mont_tail(ctx, s)

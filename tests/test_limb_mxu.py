@@ -1,0 +1,58 @@
+"""int8-MXU mont_mul decomposition: bit-identity vs the VPU path and
+the host bigint oracle (VERDICT r3 next-step 8; see ops/limb_mxu.py)."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from charon_tpu.ops import limb
+from charon_tpu.ops.limb import FP32, FR32
+from charon_tpu.ops.limb_mxu import mont_mul_mxu
+
+
+@pytest.fixture(autouse=True)
+def _no_pallas():
+    # compare the pure jnp VPU path against the MXU decomposition
+    limb.set_pallas(False)
+    yield
+    limb.set_pallas(None)
+
+
+@pytest.mark.parametrize("ctx", [FP32, FR32], ids=["fp32", "fr32"])
+def test_mont_mul_mxu_matches_vpu_and_oracle(ctx):
+    det = random.Random(99)
+    p = ctx.modulus
+    vals_a = [0, 1, p - 1, det.randrange(p), det.randrange(p), det.randrange(p)]
+    vals_b = [p - 1, 1, p - 1, det.randrange(p), det.randrange(p), 0]
+    a = limb.pack_mont_host(ctx, vals_a)
+    b = limb.pack_mont_host(ctx, vals_b)
+
+    got_mxu = jax.jit(lambda x, y: mont_mul_mxu(ctx, x, y))(a, b)
+    got_vpu = jax.jit(lambda x, y: limb.mont_mul(ctx, x, y))(a, b)
+    # bit-identical limbs between the two lowerings
+    assert np.array_equal(np.asarray(got_mxu), np.asarray(got_vpu))
+    # and the host bigint oracle agrees: mont_mul(aR, bR) = (a*b)R
+    want = [va * vb % p for va, vb in zip(vals_a, vals_b)]
+    assert limb.unpack_mont_host(ctx, got_mxu) == want
+
+
+def test_mont_mul_mxu_randomized_batch():
+    ctx = FP32
+    det = random.Random(7)
+    p = ctx.modulus
+    vals_a = [det.randrange(p) for _ in range(32)]
+    vals_b = [det.randrange(p) for _ in range(32)]
+    a = limb.pack_mont_host(ctx, vals_a)
+    b = limb.pack_mont_host(ctx, vals_b)
+    got = jax.jit(lambda x, y: mont_mul_mxu(ctx, x, y))(a, b)
+    assert limb.unpack_mont_host(ctx, got) == [
+        va * vb % p for va, vb in zip(vals_a, vals_b)
+    ]
+
+
+def test_mont_mul_mxu_rejects_wide_limbs():
+    with pytest.raises(ValueError, match="12-bit"):
+        mont_mul_mxu(limb.FP, None, None)
