@@ -14,9 +14,12 @@ import time
 from collections import defaultdict
 from typing import Any, Sequence
 
+from charon_tpu.app.errors import StructuredError
 
-class AllClientsFailedError(Exception):
-    pass
+
+class AllClientsFailedError(StructuredError):
+    """Every configured beacon client failed the call; fields carry the
+    endpoint and per-client errors (ref: app/errors at the BN boundary)."""
 
 
 _METHODS = (
@@ -105,7 +108,11 @@ class MultiClient:
                 except Exception as e:  # noqa: BLE001 — any failure fails over
                     self.errors[i] += 1
                     errs.append(f"client{i}: {e!r}")
-            raise AllClientsFailedError("; ".join(errs))
+            raise AllClientsFailedError(
+                "all beacon clients failed",
+                endpoint=name,
+                errors="; ".join(errs),
+            )
 
         return call
 

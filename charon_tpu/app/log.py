@@ -70,5 +70,11 @@ def warn(msg: str, topic: str = "app", **fields) -> None:
 def error(msg: str, topic: str = "app", exc: BaseException | None = None, **fields) -> None:
     error_counts[topic] += 1
     if exc is not None:
+        # structured-error chains contribute their merged context fields
+        # (explicit call-site fields win — ref: app/errors field logging)
+        from charon_tpu.app.errors import fields_of
+
+        for k, v in fields_of(exc).items():
+            fields.setdefault(k, v)
         fields["err"] = repr(exc)
     _root.error(_fmt(msg, topic, fields))

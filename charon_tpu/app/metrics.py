@@ -154,6 +154,11 @@ def instrument(metrics: "ClusterMetrics"):
     return option
 
 
+# cProfile is interpreter-global state: exactly one /debug/pprof/profile
+# may hold it at a time (a concurrent enable() raises on CPython 3.12)
+_PROFILE_ACTIVE = asyncio.Lock()
+
+
 async def serve_monitoring(
     host: str,
     port: int,
@@ -189,6 +194,95 @@ async def serve_monitoring(
                     _tracer.global_tracer().dump(trace_id)
                 ).encode()
                 ctype = b"application/json"
+                status = b"200 OK"
+            elif path.startswith("/debug/pprof/profile"):
+                # CPU profile of the event-loop thread for ?seconds=N
+                # (ref: monitoringapi.go net/http/pprof profile endpoint)
+                import cProfile
+                import io
+                import math
+                import pstats
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(path).query)
+                try:
+                    secs = float((q.get("seconds") or ["5"])[0])
+                except ValueError:
+                    secs = float("nan")
+                if not math.isfinite(secs) or secs < 0:
+                    body = b"bad seconds parameter"
+                    ctype = b"text/plain"
+                    status = b"400 Bad Request"
+                elif _PROFILE_ACTIVE.locked():
+                    # cProfile is interpreter-global: a second enable()
+                    # raises; serialize instead of crashing the handler
+                    body = b"another profile is already running"
+                    ctype = b"text/plain"
+                    status = b"503 Service Unavailable"
+                else:
+                    async with _PROFILE_ACTIVE:
+                        prof = cProfile.Profile()
+                        prof.enable()
+                        try:
+                            await asyncio.sleep(min(secs, 60.0))
+                        finally:
+                            prof.disable()
+                    buf = io.StringIO()
+                    pstats.Stats(prof, stream=buf).sort_stats(
+                        pstats.SortKey.CUMULATIVE
+                    ).print_stats(60)
+                    body = buf.getvalue().encode()
+                    ctype = b"text/plain"
+                    status = b"200 OK"
+            elif path.startswith("/debug/pprof/threads"):
+                # all-thread stack dump — the goroutine-dump analogue
+                import sys as _sys
+                import threading as _threading
+                import traceback as _traceback
+
+                names = {
+                    t.ident: t.name for t in _threading.enumerate()
+                }
+                parts = []
+                for tid, frame in _sys._current_frames().items():
+                    parts.append(
+                        f"--- thread {tid} ({names.get(tid, '?')}) ---\n"
+                        + "".join(_traceback.format_stack(frame))
+                    )
+                body = "\n".join(parts).encode()
+                ctype = b"text/plain"
+                status = b"200 OK"
+            elif path.startswith("/debug/pprof/heap"):
+                # allocation snapshots via tracemalloc. Tracing costs
+                # ~2x on every allocation, so it NEVER arms implicitly:
+                # ?start=1 arms, ?stop=1 disarms, bare GET reports (or
+                # explains how to arm) — unlike Go's free heap profile,
+                # the analogue here is an explicit toggle
+                import tracemalloc
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(path).query)
+                if q.get("start"):
+                    if not tracemalloc.is_tracing():
+                        tracemalloc.start(10)
+                    body = b"tracemalloc armed; GET without params for a snapshot, ?stop=1 to disarm"
+                elif q.get("stop"):
+                    if tracemalloc.is_tracing():
+                        tracemalloc.stop()
+                    body = b"tracemalloc stopped"
+                elif not tracemalloc.is_tracing():
+                    body = (
+                        b"tracemalloc not armed; GET ?start=1 to begin "
+                        b"tracing (allocation overhead until ?stop=1)"
+                    )
+                else:
+                    snap = tracemalloc.take_snapshot()
+                    lines = [
+                        str(stat)
+                        for stat in snap.statistics("lineno")[:40]
+                    ]
+                    body = "\n".join(lines).encode()
+                ctype = b"text/plain"
                 status = b"200 OK"
             elif path.startswith("/debug/consensus"):
                 body = _json.dumps(

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
 from charon_tpu.app import k1util, log
+from charon_tpu.app.errors import StructuredError
 from charon_tpu.p2p import codec
 
 MAX_FRAME = 128 * 1024 * 1024  # ref: p2p/sender.go:26
@@ -44,8 +45,9 @@ class PeerSpec:
     port: int
 
 
-class HandshakeError(Exception):
-    pass
+class HandshakeError(StructuredError):
+    """Mutual-auth failure; carries peer context fields
+    (ref: app/errors structured errors at the p2p boundary)."""
 
 
 @dataclass
@@ -216,14 +218,14 @@ class P2PNode:
             # conn gater: only registered cluster peers may connect
             # (ref: p2p/gater.go:16-77)
             if peer is None:
-                raise HandshakeError(f"unknown peer index {idx}")
+                raise HandshakeError("unknown peer index", peer=idx)
             nonce_c = bytes.fromhex(h["nonce"])
             sig = bytes.fromhex(h["sig"])
             digest = self._transcript(
                 b"charon-tpu-hello-v2", idx, self.index, nonce_s, nonce_c
             )
             if not k1util.verify_bytes(peer.pubkey, digest, sig):
-                raise HandshakeError(f"bad handshake signature from {idx}")
+                raise HandshakeError("bad handshake signature", peer=idx)
             ack = self._transcript(
                 b"charon-tpu-ack-v2", idx, self.index, nonce_s, nonce_c
             )
@@ -296,7 +298,7 @@ class P2PNode:
             peer.pubkey, ack, bytes.fromhex(a["sig"])
         ):
             writer.close()
-            raise HandshakeError(f"responder {peer.index} failed mutual auth")
+            raise HandshakeError("responder failed mutual auth", peer=peer.index)
         key = self._session_key(
             peer.pubkey, self.index, peer.index, nonce_s, nonce_c
         )

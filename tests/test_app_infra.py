@@ -468,3 +468,86 @@ def test_forkjoin_bounded_order_and_failures():
         assert flatten(ok) == [10, 20]
 
     asyncio.run(main())
+
+
+def test_structured_errors():
+    """ref: app/errors + app/z — fields, wrapping, chain aggregation,
+    sentinels, stacks without raising."""
+    from charon_tpu.app import errors
+
+    base = errors.new("dial failed", addr="1.2.3.4:9000")
+    wrapped = errors.wrap(base, "peer unreachable", peer=3, addr="outer")
+    # outermost layer wins on conflicts; inner context preserved
+    assert errors.fields_of(wrapped) == {"peer": 3, "addr": "outer"}
+    assert "peer=3" in str(wrapped)
+    # sentinel matching through the chain
+    sent = errors.sentinel("not found")
+    assert errors.is_any(errors.wrap(sent, "lookup failed", key="k"), sent)
+    assert not errors.is_any(wrapped, sent)
+    # stack available without ever raising (construct-and-log pattern)
+    assert "test_structured_errors" in base.stack()
+    # raised errors report the real traceback
+    try:
+        raise errors.new("boom", x=1)
+    except errors.StructuredError as e:
+        assert "raise errors.new" in e.stack()
+        assert errors.fields_of(e) == {"x": 1}
+    # implicit context (raise inside except) also aggregates
+    try:
+        try:
+            raise errors.new("inner", a=1)
+        except errors.StructuredError:
+            raise errors.new("outer", b=2)
+    except errors.StructuredError as e2:
+        assert errors.fields_of(e2) == {"a": 1, "b": 2}
+    # ...but `raise B from None` suppresses the context, so a handled
+    # unrelated failure's fields don't misattribute into B's log line
+    try:
+        try:
+            raise errors.new("handled fallback", addr="wrong-peer")
+        except errors.StructuredError:
+            raise errors.new("real failure", b=2) from None
+    except errors.StructuredError as e3:
+        assert errors.fields_of(e3) == {"b": 2}
+
+
+def test_pprof_endpoints():
+    """pprof-analogue debug endpoints on the monitoring API
+    (ref: app/monitoringapi.go:47 net/http/pprof registration)."""
+
+    async def run():
+        m = ClusterMetrics("0xhash", "c", "node0")
+        server = await serve_monitoring("127.0.0.1", 0, m)
+        port = server.sockets[0].getsockname()[1]
+
+        async def get(path):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+            await writer.drain()
+            data = await reader.read()
+            writer.close()
+            return data
+
+        prof = await get("/debug/pprof/profile?seconds=0.2")
+        assert b"200 OK" in prof and b"cumulative" in prof
+        # malformed / non-finite durations are a 400, not a dropped conn
+        assert b"400 Bad Request" in await get("/debug/pprof/profile?seconds=abc")
+        assert b"400 Bad Request" in await get("/debug/pprof/profile?seconds=nan")
+
+        threads = await get("/debug/pprof/threads")
+        assert b"200 OK" in threads and b"--- thread" in threads
+
+        # heap tracing NEVER arms implicitly (allocation overhead):
+        # explicit start/snapshot/stop protocol
+        assert b"not armed" in await get("/debug/pprof/heap")
+        assert b"armed" in await get("/debug/pprof/heap?start=1")
+        snap = await get("/debug/pprof/heap")
+        assert b"200 OK" in snap and b"size=" in snap
+        assert b"stopped" in await get("/debug/pprof/heap?stop=1")
+        import tracemalloc
+
+        assert not tracemalloc.is_tracing()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(run())
