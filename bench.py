@@ -153,16 +153,45 @@ def main() -> None:
     # kernel family only costs its own speedup, not the whole fast path.
     from charon_tpu.ops import fptower as FT
 
+    # BENCH_MXU=1: A/B the int8-MXU mont_mul decomposition
+    # (ops/limb_mxu.py) — fp2 fusion off so every multiply actually
+    # routes through the Toeplitz-matmul lowering
+    bench_mxu = os.environ.get("BENCH_MXU") == "1"
+    if bench_mxu and ctx.limb_bits != 12:
+        # the decomposition only exists for the 12-bit geometry (the
+        # CPU-fallback profile uses 24-bit limbs) — measuring here would
+        # present the plain kernel as an MXU number
+        hb(
+            f"BENCH_MXU=1 ignored: ctx {ctx.name} has {ctx.limb_bits}-bit "
+            "limbs, no MXU lowering"
+        )
+        bench_mxu = False
+    if bench_mxu:
+        hb("BENCH_MXU=1: int8-MXU mont_mul lowering active, fp2 fusion off")
+        limb.set_mxu(True)
+        FT.set_fp2_fusion(False)
+
     def _rung_fp2_off():
         FT.set_fp2_fusion(False)
 
     def _rung_pallas_off():
         limb.set_pallas(False)
 
-    state = {"kernel": make_kernel(), "rungs": [
-        ("without fp2 fusion", _rung_fp2_off),
-        ("without pallas", _rung_pallas_off),
-    ]}
+    def _rung_mxu_off():
+        limb.set_mxu(False)
+
+    # under BENCH_MXU the normal rungs would rebuild byte-identical
+    # kernels (fp2 fusion already off, mxu shadows pallas dispatch);
+    # the only meaningful step-down is mxu-off
+    rungs = (
+        [("without mxu", _rung_mxu_off)]
+        if bench_mxu
+        else [
+            ("without fp2 fusion", _rung_fp2_off),
+            ("without pallas", _rung_pallas_off),
+        ]
+    )
+    state = {"kernel": make_kernel(), "rungs": rungs}
 
     def run_verify(args, label: str):
         """Run the kernel; on failure step down the degradation ladder

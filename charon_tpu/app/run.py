@@ -90,6 +90,8 @@ class Node:
     tracker: Tracker
     metrics: ClusterMetrics
     beacon: object
+    sigagg: SigAgg | None = None
+    crypto_plane: object | None = None  # core.cryptoplane.SlotCoalescer
     inclusion: InclusionChecker | None = None
 
 
@@ -619,6 +621,8 @@ async def build_node(config: Config) -> Node:
         tracker=tracker,
         metrics=metrics,
         beacon=beacon,
+        sigagg=sigagg,
+        crypto_plane=crypto_plane,
         inclusion=inclusion,
     )
 

@@ -85,6 +85,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated host:port per operator (index order)",
     )
     runp.add_argument("--no-tpu", action="store_true", help="use the pure-python tbls backend")
+    # argparse validates `choices` only for command-line values, never
+    # defaults — validate the env-var binding here so a typo'd
+    # CHARON_TPU_CRYPTO_PLANE fails loudly instead of degrading to auto
+    crypto_plane_default = _env_default("crypto-plane", "auto")
+    if crypto_plane_default not in ("auto", "on", "off"):
+        raise SystemExit(
+            f"CHARON_TPU_CRYPTO_PLANE={crypto_plane_default!r}: "
+            "must be auto, on, or off"
+        )
+    runp.add_argument(
+        "--crypto-plane",
+        choices=["auto", "on", "off"],
+        default=crypto_plane_default,
+        help="sharded multi-device crypto plane: auto installs it when "
+        ">= 2 devices are visible (see core/cryptoplane.py)",
+    )
     runp.add_argument(
         "--relay",
         default=_env_default("relay", ""),
@@ -365,6 +381,7 @@ def cmd_run(args) -> int:
         slots_per_epoch=args.slots_per_epoch,
         genesis_time=args.genesis_time,
         use_tpu_tbls=not args.no_tpu,
+        crypto_plane=args.crypto_plane,
         relay_addr=args.relay,
     )
     run_coro(run(config))

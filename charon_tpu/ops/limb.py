@@ -471,6 +471,28 @@ def _pallas_active(ctx: ModCtx) -> bool:
     return _is_tpu_backend()
 
 
+# int8-MXU dispatch (ops/limb_mxu.py): opt-in until measured on real TPU
+# (set CHARON_MXU_MONT=1 or call set_mxu(True); bench.py exposes it as
+# BENCH_MXU=1). Takes precedence over the Pallas kernel when enabled so
+# the two lowerings can be A/B'd from the same bench invocation.
+_MXU_MODE: bool | None = None
+
+
+def set_mxu(mode: bool | None) -> None:
+    global _MXU_MODE
+    _MXU_MODE = mode
+
+
+def _mxu_active(ctx: ModCtx) -> bool:
+    if ctx.limb_bits != 12:
+        return False
+    if _MXU_MODE is not None:
+        return _MXU_MODE
+    import os
+
+    return os.environ.get("CHARON_MXU_MONT") == "1"
+
+
 def mont_mul(ctx: ModCtx, a, b):
     """a * b * R^-1 mod m for reduced Montgomery-form inputs.
 
@@ -485,6 +507,10 @@ def mont_mul(ctx: ModCtx, a, b):
     Three convolutions + parallel carry normalization replace the n-round
     scan: ~10x fewer XLA ops and no serialization on the limb axis.
     """
+    if _mxu_active(ctx):
+        from charon_tpu.ops.limb_mxu import mont_mul_mxu
+
+        return mont_mul_mxu(ctx, a, b)
     if _pallas_active(ctx):
         from charon_tpu.ops.pallas_mont import mont_mul_pallas
 

@@ -109,3 +109,54 @@ def test_app_run_single_node_simnet(tmp_path):
             await node.vapi_router.stop()
 
     asyncio.run(run())
+
+
+def test_app_wires_crypto_plane_on_multidevice(tmp_path):
+    """build_node with the TPU backend on a multi-device backend (the
+    8-device virtual CPU mesh here) installs the SlotCoalescer and
+    routes SigAgg / ParSigEx / ValidatorAPI through it; crypto_plane=off
+    opts out (VERDICT r3 next-step 3 production wiring)."""
+    from charon_tpu.cmd.cli import main as cli
+
+    out = tmp_path / "c"
+    cli(
+        [
+            "create-cluster",
+            "--nodes", "2",
+            "--threshold", "2",
+            "--validators", "1",
+            "--output-dir", str(out),
+        ]
+    )
+
+    async def run():
+        from charon_tpu.app.run import Config, build_node
+        from charon_tpu.core.cryptoplane import SlotCoalescer
+
+        node = await build_node(
+            Config(
+                data_dir=str(out / "node0"),
+                node_index=0,
+                simnet=True,
+                use_tpu_tbls=True,  # conftest provisions 8 CPU devices
+            )
+        )
+        plane = node.sigagg.plane
+        assert isinstance(plane, SlotCoalescer)
+        assert node.vapi.plane is plane
+        assert node.sigagg.pubshares_by_idx is not None
+        assert plane.plane.shard_count() == 8
+        assert plane.metrics_hook is not None
+
+        node_off = await build_node(
+            Config(
+                data_dir=str(out / "node1"),
+                node_index=1,
+                simnet=True,
+                use_tpu_tbls=True,
+                crypto_plane="off",
+            )
+        )
+        assert node_off.sigagg.plane is None
+
+    asyncio.run(run())

@@ -56,3 +56,23 @@ def test_mont_mul_mxu_randomized_batch():
 def test_mont_mul_mxu_rejects_wide_limbs():
     with pytest.raises(ValueError, match="12-bit"):
         mont_mul_mxu(limb.FP, None, None)
+
+
+def test_mxu_dispatch_flag(monkeypatch):
+    """set_mxu routes mont_mul through the decomposition (and wins over
+    pallas); None restores env-driven auto (off on CPU)."""
+    # a developer's exported CHARON_MXU_MONT=1 must not skew this A/B
+    monkeypatch.delenv("CHARON_MXU_MONT", raising=False)
+    ctx = FP32
+    a = limb.pack_mont_host(ctx, [12345])
+    b = limb.pack_mont_host(ctx, [67890])
+    want = np.asarray(limb.mont_mul(ctx, a, b))
+    limb.set_mxu(True)
+    try:
+        assert limb._mxu_active(ctx)
+        assert not limb._mxu_active(limb.FP)  # 24-bit geometry never
+        got = np.asarray(limb.mont_mul(ctx, a, b))
+    finally:
+        limb.set_mxu(None)
+    assert not limb._mxu_active(ctx)
+    assert np.array_equal(got, want)

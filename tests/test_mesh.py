@@ -4,8 +4,10 @@ group verify, psum'd validity count — cross-checked against the pure host
 oracle (mirror of the reference's cross-impl suite,
 ref: tbls/tbls_test.go:209-237).
 
-All cases use t=3 and a padded V of 8 so a single compiled kernel serves
-every test (XLA compiles per shape)."""
+The original cases use t=3 and a padded V of 8 so one compiled kernel
+serves them all (XLA compiles per shape); the realistic-shape tests at
+the bottom INTENTIONALLY add their own shapes (264-lane verify, 72-lane
+recombine) — each is a fresh pairing-program compile in this tier."""
 
 import random
 
@@ -263,3 +265,128 @@ def test_coalescer_on_real_mesh(plane):
     assert r2 == [False]
     assert coal.coalesced_flushes == 2  # recombine flush + verify flush
     assert coal.flushes == 2
+
+
+# ---------------------------------------------------------------------------
+# Realistic shapes (VERDICT r3 next-step 4). These compile fresh LARGE
+# programs; loading another big executable late in a program-heavy
+# process is the documented persistent-cache segfault trigger (CI.md
+# "Known environment flake"), so each runs in a fresh pinned subprocess
+# via isolation_util — the same containment as the tbls RLC tests.
+# ---------------------------------------------------------------------------
+
+from isolation_util import ISOLATED_HEADER as _ISOLATED_HEADER
+from isolation_util import run_isolated as _run_isolated
+
+_REALISTIC_VERIFY_SCRIPT = _ISOLATED_HEADER + """
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from charon_tpu.crypto import bls, h2c
+from charon_tpu.crypto.fields import R
+from charon_tpu.crypto.g1g2 import g1_to_bytes, g2_to_bytes
+from charon_tpu.parallel import SlotCryptoPlane, make_mesh
+from charon_tpu.tbls.native_impl import NativeImpl
+
+assert len(jax.devices()) == 8, "inherited XLA_FLAGS must provision 8 devices"
+plane = SlotCryptoPlane(make_mesh(jax.devices()), t=3)
+
+# 257 lanes: NOT divisible by the 8-device mesh (padded to 264, so the
+# mesh carries uneven live lanes); lane 123 holds a FORGED signature.
+n = 257
+forged_idx = 123
+det = random.Random(4242)
+msg_pool_raw = [b"mesh-verify-%d" % i for i in range(8)]
+msg_pool = [h2c.hash_to_g2(m) for m in msg_pool_raw]
+sks = [det.randrange(1, R) for _ in range(n)]
+pks = [bls.sk_to_pk(sk) for sk in sks]
+msgs = [msg_pool[i % 8] for i in range(n)]
+sigs = [bls.sign(sks[i], msg_pool_raw[i % 8]) for i in range(n)]
+sigs[forged_idx] = bls.sign(det.randrange(1, R), msg_pool_raw[forged_idx % 8])
+
+pk, msg, sig, live = plane.pack_verify_inputs(pks, msgs, sigs)
+assert int(live.shape[0]) == 264  # 257 padded to 8*33: uneven shards
+rand = plane.make_lane_rand(n, rng=random.Random(7))
+
+# masked: the forged lane contributes exponent 0 -> whole batch verifies
+live_masked = jnp.asarray(np.arange(int(live.shape[0])) < n) & (
+    jnp.arange(int(live.shape[0])) != forged_idx
+)
+assert bool(plane._verify_rlc(pk, msg, sig, live_masked, rand))
+
+# unmasked, via the PUBLIC entry point the coalescer calls: the RLC
+# pass refuses the batch, the per-lane fallback attributes — and the
+# result is bit-identical to the native host oracle on all 257 lanes
+ok = plane.verify_host(pks, msgs, sigs, rng=random.Random(8))
+impl = NativeImpl()
+oracle = []
+for i in range(n):
+    try:
+        impl.verify(
+            g1_to_bytes(pks[i]), msg_pool_raw[i % 8], g2_to_bytes(sigs[i])
+        )
+        oracle.append(True)
+    except Exception:
+        oracle.append(False)
+assert ok == oracle
+assert oracle == [i != forged_idx for i in range(n)]
+print("REALISTIC-VERIFY-OK")
+"""
+
+
+def test_sharded_verify_realistic_shape():
+    """257 uneven-sharded lanes with a masked forged lane; per-lane
+    attribution bit-identical to the native host oracle (body runs in a
+    fresh subprocess — see section comment)."""
+    _run_isolated(_REALISTIC_VERIFY_SCRIPT, "REALISTIC-VERIFY-OK")
+
+
+_REALISTIC_RECOMBINE_SCRIPT = _ISOLATED_HEADER + """
+import random
+
+import jax
+
+from charon_tpu.crypto import bls, h2c, shamir
+from charon_tpu.crypto.fields import R
+from charon_tpu.parallel import SlotCryptoPlane, make_mesh
+
+assert len(jax.devices()) == 8
+T = 3
+plane = SlotCryptoPlane(make_mesh(jax.devices()), t=T)
+
+# 67 validators: padded to 72 over 8 shards, 5 masked padding lanes
+v = 67
+pubshares, msgs, partials, group_pks, indices = [], [], [], [], []
+for i in range(v):
+    det = random.Random(1000 + i)
+    sk = bls.keygen(bytes([i % 255 + 1]) * 32)
+    shares = shamir.split(sk, T + 1, T, rand=lambda: det.randrange(1, R))
+    msg = b"mesh-duty-%d" % i
+    idx = sorted(shares)[:T]
+    pubshares.append([bls.sk_to_pk(shares[j]) for j in idx])
+    partials.append([bls.sign(shares[j], msg) for j in idx])
+    msgs.append(h2c.hash_to_g2(msg))
+    group_pks.append(bls.sk_to_pk(sk))
+    indices.append(idx)
+
+sigs, oks = plane.recombine_host(
+    pubshares, msgs, partials, group_pks, indices, rng=random.Random(3)
+)
+assert oks == [True] * v
+for lane in (0, 13, 41, 66):
+    want = shamir.threshold_aggregate_g2(
+        dict(zip(indices[lane], partials[lane]))
+    )
+    assert sigs[lane] == want
+print("REALISTIC-RECOMBINE-OK")
+"""
+
+
+def test_sharded_recombine_uneven_vs_oracle():
+    """67 validators recombine+verify in one sharded RLC program;
+    group signatures bit-identical to the host Lagrange oracle (body
+    runs in a fresh subprocess — see section comment)."""
+    _run_isolated(_REALISTIC_RECOMBINE_SCRIPT, "REALISTIC-RECOMBINE-OK")
