@@ -85,19 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated host:port per operator (index order)",
     )
     runp.add_argument("--no-tpu", action="store_true", help="use the pure-python tbls backend")
+    # Empty env binding (unset compose templating) falls back to auto;
     # argparse validates `choices` only for command-line values, never
-    # defaults — validate the env-var binding here so a typo'd
-    # CHARON_TPU_CRYPTO_PLANE fails loudly instead of degrading to auto
-    crypto_plane_default = _env_default("crypto-plane", "auto")
-    if crypto_plane_default not in ("auto", "on", "off"):
-        raise SystemExit(
-            f"CHARON_TPU_CRYPTO_PLANE={crypto_plane_default!r}: "
-            "must be auto, on, or off"
-        )
+    # defaults, so a typo'd CHARON_TPU_CRYPTO_PLANE is caught in
+    # cmd_run — at parser-build time it would abort EVERY subcommand.
     runp.add_argument(
         "--crypto-plane",
         choices=["auto", "on", "off"],
-        default=crypto_plane_default,
+        default=_env_default("crypto-plane", "") or "auto",
         help="sharded multi-device crypto plane: auto installs it when "
         ">= 2 devices are visible (see core/cryptoplane.py)",
     )
@@ -360,6 +355,14 @@ def cmd_create_cluster(args) -> int:
 
 def cmd_run(args) -> int:
     from charon_tpu.app.run import Config, run
+
+    if args.crypto_plane not in ("auto", "on", "off"):
+        # env-var default bypassed argparse choices validation
+        print(
+            f"--crypto-plane {args.crypto_plane!r}: must be auto, on, or off",
+            file=sys.stderr,
+        )
+        return 2
 
     peer_addrs = []
     if args.peers:

@@ -1,0 +1,33 @@
+"""bench.py supervisor: the driver gets ONE JSON line even when the
+bench process dies of the known persistent-cache segfault (CI.md)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_supervisor_reports_crashed_child():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env={
+            **os.environ,
+            "CHARON_BENCH_TEST_CRASH": "1",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["metric"] == "batched_bls_verify"
+    assert out["value"] == 0.0
+    assert "crashed twice" in out["error"]
+    # both attempts visible in the supervisor's heartbeat stream
+    assert proc.stderr.count("died rc=") == 2

@@ -173,98 +173,121 @@ def test_2d_mesh_dcn_ici_layout():
     assert total == v
 
 
-def test_coalescer_on_real_mesh(plane):
+def test_coalescer_on_real_mesh():
     """The production coalescer path (SigAgg -> SlotCoalescer ->
     SlotCryptoPlane.recombine_host / verify_host) on the REAL sharded
     plane: two concurrent duties share one recombine program, a verify
     burst shares one verify program, results match the host oracle, and
-    a forged verify lane is attributed by the per-lane fallback."""
-    import asyncio
+    a forged verify lane is attributed by the per-lane fallback.
 
-    from charon_tpu import tbls as tbls_pkg
-    from charon_tpu.core import eth2data as d
-    from charon_tpu.core.cryptoplane import SlotCoalescer
-    from charon_tpu.core.sigagg import SigAgg
-    from charon_tpu.core.types import Duty, DutyType, pubkey_from_bytes
-    from charon_tpu.eth2util.signing import ForkInfo
-    from charon_tpu.tbls.python_impl import PythonImpl
+    Body runs in a fresh pinned subprocess: in the full slow tier this
+    test loads its programs late in a program-heavy process — the
+    documented persistent-cache segfault trigger (CI.md; observed on a
+    cache READ in verify_host during the round-4 full-tier run)."""
+    _run_isolated(_COALESCER_SCRIPT, "COALESCER-MESH-OK", timeout=2400)
 
-    fork = ForkInfo(
-        genesis_validators_root=b"\x11" * 32,
-        fork_version=b"\x00\x00\x00\x01",
-        genesis_fork_version=b"\x00" * 4,
+
+_COALESCER_SCRIPT_BODY = r"""
+import asyncio
+import random
+
+import jax
+
+from charon_tpu import tbls as tbls_pkg
+from charon_tpu.core import eth2data as d
+from charon_tpu.core.cryptoplane import SlotCoalescer
+from charon_tpu.core.sigagg import SigAgg
+from charon_tpu.core.types import Duty, DutyType, pubkey_from_bytes
+from charon_tpu.eth2util.signing import ForkInfo
+from charon_tpu.parallel import SlotCryptoPlane, make_mesh
+from charon_tpu.tbls.python_impl import PythonImpl
+
+assert len(jax.devices()) == 8
+T = 3
+plane = SlotCryptoPlane(make_mesh(jax.devices()), t=T)
+
+fork = ForkInfo(
+    genesis_validators_root=b"\x11" * 32,
+    fork_version=b"\x00\x00\x00\x01",
+    genesis_fork_version=b"\x00" * 4,
+)
+impl = PythonImpl()
+tbls_pkg.set_implementation(impl)
+coal = SlotCoalescer(plane, window=0.01)
+
+
+def duty_workload(slot):
+    sk = impl.generate_secret_key()
+    shares = impl.threshold_split(sk, T + 1, T)
+    gpk = impl.secret_to_public_key(sk)
+    pk = pubkey_from_bytes(gpk)
+    att = d.Attestation(
+        aggregation_bits=(True,),
+        data=d.AttestationData(
+            slot=slot,
+            index=0,
+            beacon_block_root=b"\x22" * 32,
+            source=d.Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=d.Checkpoint(epoch=1, root=b"\x33" * 32),
+        ),
     )
-    impl = PythonImpl()
-    tbls_pkg.set_implementation(impl)
-    coal = SlotCoalescer(plane, window=0.01)
-
-    def duty_workload(slot):
-        sk = impl.generate_secret_key()
-        shares = impl.threshold_split(sk, T + 1, T)
-        gpk = impl.secret_to_public_key(sk)
-        pk = pubkey_from_bytes(gpk)
-        att = d.Attestation(
-            aggregation_bits=(True,),
-            data=d.AttestationData(
-                slot=slot,
-                index=0,
-                beacon_block_root=b"\x22" * 32,
-                source=d.Checkpoint(epoch=0, root=b"\x00" * 32),
-                target=d.Checkpoint(epoch=1, root=b"\x33" * 32),
-            ),
+    unsigned = d.SignedData("attestation", att)
+    root = unsigned.signing_root(fork, slot // 32)
+    psigs = [
+        d.ParSignedData(
+            data=unsigned.with_signature(impl.sign(shares[i], root)),
+            share_idx=i,
         )
-        unsigned = d.SignedData("attestation", att)
-        root = unsigned.signing_root(fork, slot // 32)
-        psigs = [
-            d.ParSignedData(
-                data=unsigned.with_signature(impl.sign(shares[i], root)),
-                share_idx=i,
-            )
-            for i in sorted(shares)[:T]
-        ]
-        want = impl.threshold_aggregate(
-            {p.share_idx: p.data.signature for p in psigs}
-        )
-        pubshares = {i: impl.secret_to_public_key(s) for i, s in shares.items()}
-        return pk, gpk, psigs, root, want, pubshares
-
-    pk1, gpk1, psigs1, root1, want1, ps1 = duty_workload(3)
-    pk2, gpk2, psigs2, root2, want2, ps2 = duty_workload(3)
-    pubshares_by_idx = {
-        i: {pk1: ps1[i], pk2: ps2[i]} for i in ps1
-    }
-    agg = SigAgg(
-        threshold=T, fork=fork, plane=coal, pubshares_by_idx=pubshares_by_idx
+        for i in sorted(shares)[:T]
+    ]
+    want = impl.threshold_aggregate(
+        {p.share_idx: p.data.signature for p in psigs}
     )
-    out = {}
+    pubshares = {i: impl.secret_to_public_key(s) for i, s in shares.items()}
+    return pk, gpk, psigs, root, want, pubshares
 
-    async def on_agg(duty, data_set):
-        out.update(data_set)
 
-    agg.subscribe(on_agg)
+pk1, gpk1, psigs1, root1, want1, ps1 = duty_workload(3)
+pk2, gpk2, psigs2, root2, want2, ps2 = duty_workload(3)
+pubshares_by_idx = {i: {pk1: ps1[i], pk2: ps2[i]} for i in ps1}
+agg = SigAgg(
+    threshold=T, fork=fork, plane=coal, pubshares_by_idx=pubshares_by_idx
+)
+out = {}
 
-    async def main():
-        await asyncio.gather(
-            agg.aggregate(Duty(3, DutyType.ATTESTER), {pk1: psigs1}),
-            agg.aggregate(Duty(3, DutyType.SYNC_MESSAGE), {pk2: psigs2}),
-        )
-        # verify burst: two components submit within one window; one
-        # lane is forged -> RLC says no -> per-lane program attributes
-        sig_ok = psigs1[0].data.signature
-        forged = impl.sign(impl.generate_secret_key(), root1)
-        r1, r2 = await asyncio.gather(
-            coal.verify([(ps1[psigs1[0].share_idx], root1, sig_ok)]),
-            coal.verify([(ps1[psigs1[0].share_idx], root1, forged)]),
-        )
-        return r1, r2
 
-    r1, r2 = asyncio.run(main())
-    assert out[pk1].signature == want1
-    assert out[pk2].signature == want2
-    assert r1 == [True]
-    assert r2 == [False]
-    assert coal.coalesced_flushes == 2  # recombine flush + verify flush
-    assert coal.flushes == 2
+async def on_agg(duty, data_set):
+    out.update(data_set)
+
+
+agg.subscribe(on_agg)
+
+
+async def main():
+    await asyncio.gather(
+        agg.aggregate(Duty(3, DutyType.ATTESTER), {pk1: psigs1}),
+        agg.aggregate(Duty(3, DutyType.SYNC_MESSAGE), {pk2: psigs2}),
+    )
+    # verify burst: two components submit within one window; one lane
+    # is forged -> RLC says no -> per-lane program attributes
+    sig_ok = psigs1[0].data.signature
+    forged = impl.sign(impl.generate_secret_key(), root1)
+    r1, r2 = await asyncio.gather(
+        coal.verify([(ps1[psigs1[0].share_idx], root1, sig_ok)]),
+        coal.verify([(ps1[psigs1[0].share_idx], root1, forged)]),
+    )
+    return r1, r2
+
+
+r1, r2 = asyncio.run(main())
+assert out[pk1].signature == want1
+assert out[pk2].signature == want2
+assert r1 == [True]
+assert r2 == [False]
+assert coal.coalesced_flushes == 2  # recombine flush + verify flush
+assert coal.flushes == 2
+print("COALESCER-MESH-OK")
+"""
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +300,8 @@ def test_coalescer_on_real_mesh(plane):
 
 from isolation_util import ISOLATED_HEADER as _ISOLATED_HEADER
 from isolation_util import run_isolated as _run_isolated
+
+_COALESCER_SCRIPT = _ISOLATED_HEADER + _COALESCER_SCRIPT_BODY
 
 _REALISTIC_VERIFY_SCRIPT = _ISOLATED_HEADER + """
 import random
