@@ -73,6 +73,9 @@ class Config:
     # single-device TPUImpl path), "on" forces it, "off" disables
     crypto_plane: str = "auto"
     crypto_plane_window: float = 0.02  # coalescing window, seconds
+    # OTLP/HTTP collector for workflow spans (ref: --jaeger-address,
+    # app/app.go:1014-1027 wireTracing); "" disables export
+    tracing_endpoint: str = ""
 
 
 @dataclass
@@ -594,6 +597,25 @@ async def build_node(config: Config) -> Node:
             await _asyncio.sleep(interval)
 
     life.register_start(Order.MONITORING, "health-sampler", _sample_health_loop)
+
+    if config.tracing_endpoint:
+        # ref: app/app.go:162 wireTracing — spans flow to the collector
+        # for the node's whole life; flushed at shutdown.
+        otlp = tracer.OTLPExporter(
+            config.tracing_endpoint,
+            service_name=f"charon-tpu-node{config.node_index}",
+        )
+        tracer.set_global_tracer(tracer.Tracer(exporter=otlp))
+
+        async def stop_tracing():
+            # shutdown joins the export thread (final POST can take
+            # seconds against a dead collector) — keep the loop free so
+            # later stop hooks' grace timeouts still fire
+            await asyncio.get_running_loop().run_in_executor(
+                None, otlp.shutdown
+            )
+
+        life.register_stop(Order.MONITORING, "tracing", stop_tracing)
 
     if config.monitoring_port:
         consensus_dump = getattr(qbft_consensus, "debug_dump", None)

@@ -53,13 +53,162 @@ _current: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
 )
 
 
-class Tracer:
-    """Ring-buffered span store with optional JSONL export
-    (ref: app/tracer Init wiring, app/app.go:1014-1027)."""
+def _otlp_value(v) -> dict:
+    """Map a Python attribute value to an OTLP JSON AnyValue."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
 
-    def __init__(self, capacity: int = 4096, jsonl_path: str | None = None):
+
+def span_to_otlp(span: "Span") -> dict:
+    """One span in OTLP/JSON encoding (opentelemetry-proto trace.v1.Span)."""
+    return {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "parentSpanId": span.parent_id,
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(span.start * 1e9)),
+        "endTimeUnixNano": str(int(span.end * 1e9)),
+        "attributes": [
+            {"key": k, "value": _otlp_value(v)} for k, v in span.attrs.items()
+        ],
+        "status": {"code": 2 if span.status == "error" else 1},
+    }
+
+
+class OTLPExporter:
+    """Pushes spans to an OTLP/HTTP collector (`/v1/traces`, JSON
+    encoding) — the standard Jaeger ≥1.35 / otel-collector ingest.
+    Mirrors ref: app/tracer/trace.go:40-124 which exports via OTLP
+    to Jaeger. Dependency-free: urllib POST from a background thread;
+    spans batch until `batch_size` or `flush_interval`, and a dead
+    collector drops batches (bounded queue) rather than stalling the
+    node — tracing must never backpressure duty processing."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "charon-tpu",
+        batch_size: int = 256,
+        flush_interval: float = 5.0,
+        max_queue: int = 8192,
+    ):
+        import queue
+        import threading
+
+        if not endpoint.rstrip("/").endswith("/v1/traces"):
+            endpoint = endpoint.rstrip("/") + "/v1/traces"
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.dropped = 0  # spans lost to a full queue / dead collector
+        self.exported = 0
+        self._q: "queue.Queue[Span | None]" = queue.Queue(maxsize=max_queue)
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def offer(self, span: "Span") -> None:
+        try:
+            self._q.put_nowait(span)
+        except Exception:
+            self.dropped += 1
+
+    def _post(self, batch: list["Span"]) -> None:
+        import urllib.request
+
+        body = json.dumps(
+            {
+                "resourceSpans": [
+                    {
+                        "resource": {
+                            "attributes": [
+                                {
+                                    "key": "service.name",
+                                    "value": {"stringValue": self.service_name},
+                                }
+                            ]
+                        },
+                        "scopeSpans": [
+                            {
+                                "scope": {"name": "charon_tpu.app.tracer"},
+                                "spans": [span_to_otlp(s) for s in batch],
+                            }
+                        ],
+                    }
+                ]
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.endpoint,
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5.0):
+                self.exported += len(batch)
+        except Exception:
+            self.dropped += len(batch)
+
+    def _run(self) -> None:
+        import queue
+
+        batch: list[Span] = []
+        deadline = time.monotonic() + self.flush_interval
+        while True:
+            timeout = max(0.0, deadline - time.monotonic())
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                item = ()  # timer tick
+            if item is None:  # shutdown sentinel
+                if batch:
+                    self._post(batch)
+                return
+            if item != ():
+                batch.append(item)
+            if len(batch) >= self.batch_size or (
+                batch and time.monotonic() >= deadline
+            ):
+                self._post(batch)
+                batch = []
+            if time.monotonic() >= deadline:
+                deadline = time.monotonic() + self.flush_interval
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Flush pending spans and stop the export thread. A full queue
+        still gets its sentinel (blocking put with a bound) so the
+        flush-on-shutdown contract holds after a long collector outage."""
+        import queue
+
+        try:
+            self._q.put(None, timeout=timeout / 2)
+        except queue.Full:
+            return  # exporter thread is wedged; give up without joining
+        self._thread.join(timeout=timeout)
+
+
+class Tracer:
+    """Ring-buffered span store with optional JSONL export and optional
+    OTLP/HTTP push (ref: app/tracer Init wiring, app/app.go:1014-1027)."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        jsonl_path: str | None = None,
+        exporter: OTLPExporter | None = None,
+    ):
         self.spans: deque[Span] = deque(maxlen=capacity)
         self.jsonl_path = jsonl_path
+        self.exporter = exporter
         self._file = None
 
     def record(self, span: Span) -> None:
@@ -72,6 +221,8 @@ class Tracer:
                 self._file = open(self.jsonl_path, "a")
             self._file.write(json.dumps(span.to_json()) + "\n")
             self._file.flush()
+        if self.exporter is not None:
+            self.exporter.offer(span)
 
     def dump(self, trace_id: str | None = None) -> list[dict]:
         return [
@@ -84,6 +235,8 @@ class Tracer:
         if self._file:
             self._file.close()
             self._file = None
+        if self.exporter is not None:
+            self.exporter.shutdown()
 
 
 _GLOBAL = Tracer()

@@ -102,6 +102,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="host:port of a charon-tpu relay for NAT fallback dials",
     )
     runp.add_argument(
+        "--tracing-endpoint",
+        default=_env_default("tracing-endpoint", ""),
+        help="OTLP/HTTP collector base URL for workflow spans "
+        "(e.g. http://jaeger:4318; ref charon --jaeger-address)",
+    )
+    runp.add_argument(
         "--beacon-urls",
         default=_env_default("beacon-urls", ""),
         help="comma-separated beacon-node HTTP endpoints (failover order)",
@@ -385,6 +391,7 @@ def cmd_run(args) -> int:
         genesis_time=args.genesis_time,
         use_tpu_tbls=not args.no_tpu,
         crypto_plane=args.crypto_plane,
+        tracing_endpoint=args.tracing_endpoint,
         relay_addr=args.relay,
     )
     run_coro(run(config))

@@ -551,3 +551,96 @@ def test_pprof_endpoints():
         await server.wait_closed()
 
     asyncio.run(run())
+
+
+def test_otlp_exporter_end_to_end():
+    """Spans recorded through a Tracer with an OTLPExporter arrive at a
+    local OTLP/HTTP collector in the standard JSON encoding
+    (ref: app/tracer/trace.go:40-124 exports OTLP to Jaeger)."""
+    import http.server
+    import json
+    import threading
+
+    from charon_tpu.app import tracer as trc
+
+    received = []
+    got = threading.Event()
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            got.set()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        exporter = trc.OTLPExporter(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            service_name="charon-tpu-test",
+            flush_interval=0.2,
+        )
+        t = trc.Tracer(exporter=exporter)
+        duty = Duty(slot=7, type=DutyType.ATTESTER)
+        with trc.span("fetcher", duty=duty, tracer=t, share=3):
+            with trc.span("consensus", tracer=t):
+                pass
+        with pytest.raises(RuntimeError):
+            with trc.span("sigagg", duty=duty, tracer=t):
+                raise RuntimeError("boom")
+        assert got.wait(5.0), "collector never received a batch"
+        exporter.shutdown()
+
+        path, payload = received[0]
+        assert path == "/v1/traces"
+        rs = payload["resourceSpans"][0]
+        res_attrs = {
+            a["key"]: a["value"]["stringValue"]
+            for a in rs["resource"]["attributes"]
+        }
+        assert res_attrs["service.name"] == "charon-tpu-test"
+        spans = [
+            s
+            for batch in received
+            for s in batch[1]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        ]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"fetcher", "consensus", "sigagg"}
+        fetcher, consensus = by_name["fetcher"], by_name["consensus"]
+        # duty-rooted deterministic trace id, child nests under parent
+        assert fetcher["traceId"] == trc.duty_trace_id(duty)
+        assert consensus["traceId"] == fetcher["traceId"]
+        assert consensus["parentSpanId"] == fetcher["spanId"]
+        assert len(fetcher["traceId"]) == 32 and len(fetcher["spanId"]) == 16
+        # OTLP status codes: OK=1, ERROR=2; nanosecond string timestamps
+        assert fetcher["status"]["code"] == 1
+        assert by_name["sigagg"]["status"]["code"] == 2
+        assert int(fetcher["endTimeUnixNano"]) >= int(
+            fetcher["startTimeUnixNano"]
+        )
+        attrs = {a["key"]: a["value"] for a in fetcher["attributes"]}
+        assert attrs["share"] == {"intValue": "3"}
+        assert exporter.exported == 3 and exporter.dropped == 0
+    finally:
+        srv.shutdown()
+
+
+def test_otlp_exporter_dead_collector_drops():
+    """A dead collector must never stall recording — spans are counted
+    dropped and the caller is unaffected."""
+    from charon_tpu.app import tracer as trc
+
+    exporter = trc.OTLPExporter(
+        "http://127.0.0.1:1", flush_interval=0.1, batch_size=1
+    )
+    t = trc.Tracer(exporter=exporter)
+    with trc.span("step", tracer=t):
+        pass
+    exporter.shutdown()
+    assert exporter.dropped >= 1 and exporter.exported == 0
