@@ -615,7 +615,10 @@ async def build_node(config: Config) -> Node:
                 None, otlp.shutdown
             )
 
-        life.register_stop(Order.MONITORING, "tracing", stop_tracing)
+        # TRACKER order (lowest): stop hooks run highest-first, so the
+        # exporter flushes AFTER p2p/beacon teardown — spans recorded
+        # during other components' shutdown still reach the collector
+        life.register_stop(Order.TRACKER, "tracing", stop_tracing)
 
     if config.monitoring_port:
         consensus_dump = getattr(qbft_consensus, "debug_dump", None)

@@ -508,6 +508,13 @@ def mont_mul(ctx: ModCtx, a, b):
     scan: ~10x fewer XLA ops and no serialization on the limb axis.
     """
     if _mxu_active(ctx):
+        # with Pallas also active, the Toeplitz matmuls are issued from
+        # inside the fused kernel (int8 pieces stay in VMEM); Pallas-off
+        # keeps the XLA-level lowering as the A/B reference
+        if _pallas_active(ctx):
+            from charon_tpu.ops.pallas_mont import mont_mul_pallas
+
+            return mont_mul_pallas(ctx, a, b, mxu=True)
         from charon_tpu.ops.limb_mxu import mont_mul_mxu
 
         return mont_mul_mxu(ctx, a, b)

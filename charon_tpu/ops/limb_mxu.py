@@ -77,11 +77,15 @@ def _modulus_toeplitz(ctx: ModCtx):
     return _toeplitz_pieces(ctx.limbs, ctx.n_limbs, 2 * ctx.n_limbs)
 
 
-def conv_const_mxu(ctx: ModCtx, a, pieces):
+def conv_const_mxu(a, T0, T1):
     """conv(a, c) for canonical-limb `a` and a constant c given as
-    Toeplitz 6-bit pieces — four int8 matmuls on the MXU, recombined in
-    uint32 accumulator range."""
-    T0, T1 = pieces
+    Toeplitz 6-bit piece matrices — four int8 matmuls on the MXU,
+    recombined in uint32 accumulator range. The ONE copy of the
+    piece-split/recombine math: the XLA-level mont_mul_mxu below and the
+    fused Pallas kernel (ops/pallas_mont.py) both call it — T0/T1 may be
+    numpy constants (XLA folds them) or VMEM ref loads. _PIECE_MASK is a
+    Python int, so nothing here is a captured jnp constant (pallas_call
+    rejects those)."""
     a = a.astype(jnp.int32)
     a0 = (a & _PIECE_MASK).astype(jnp.int8)
     a1 = (a >> _PIECE_BITS).astype(jnp.int8)
@@ -115,7 +119,7 @@ def mont_mul_mxu(ctx: ModCtx, a, b):
     n = ctx.n_limbs
     t = limb._conv_full(ctx, a, b)  # data-dependent: stays VPU
     t, _ = limb._normalize(ctx, t)
-    m = conv_const_mxu(ctx, t[..., :n], _ninv_toeplitz(ctx))
+    m = conv_const_mxu(t[..., :n], *_ninv_toeplitz(ctx))
     m, _ = limb._normalize(ctx, m)  # mod R: top carry intentionally dropped
-    s = t + conv_const_mxu(ctx, m, _modulus_toeplitz(ctx))
+    s = t + conv_const_mxu(m, *_modulus_toeplitz(ctx))
     return limb._mont_tail(ctx, s)

@@ -31,6 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from charon_tpu.ops.limb import ModCtx, _r_minus_m, int_to_limbs
 
+
 # batch rows per grid step — (8, 128) native tiles; 256 rows x 64 cols
 # of u32 = 64 KiB per scratch-sized value, far under ~16 MiB VMEM.
 TILE = 256
@@ -146,6 +147,43 @@ def _unpack_consts(ctx: ModCtx, consts_ref) -> _K:
     )
 
 
+def _conv_const_mxu(a, T0, T1):
+    """conv(a, c) with the constant given as 6-bit Toeplitz pieces: the
+    shared four-int8-matmul recombination (ops/limb_mxu.conv_const_mxu),
+    here fed VMEM ref loads so the systolic array does the constant
+    convolutions while the band intermediates never touch HBM."""
+    from charon_tpu.ops.limb_mxu import conv_const_mxu
+
+    return conv_const_mxu(a, T0, T1)
+
+
+def _mont_core_mxu(k: _K, a, b, nT0, nT1, pT0, pT1):
+    """_mont_core with the two constant-operand convolutions (t * ninv
+    mod R and m * p) on the MXU. The data-dependent a * b product keeps
+    the VPU unrolled conv — no constant matrix to feed the MXU with.
+    Value ranges match the VPU path: every recombined column < 2^30
+    (32 terms x 63^2 per 6-bit partial), inside what _normalize's three
+    shift passes + Kogge resolve are built for."""
+    rows = a.shape[0]
+    n, nbits, mask = k.n, k.nbits, k.mask
+
+    t = jnp.zeros((rows, 2 * n), jnp.uint32)
+    t = _conv_into(t, a, b, n, 2 * n)
+    t, _ = _normalize(t, nbits, mask, 2 * n)
+
+    m = _conv_const_mxu(t[:, :n], nT0, nT1)
+    m, _ = _normalize(m, nbits, mask, n)  # mod R: top carry dropped
+
+    s = t + _conv_const_mxu(m, pT0, pT1)
+    s2 = s + k.rm2n
+    out1, _ = _normalize(s, nbits, mask, 2 * n)
+    out2, carry2 = _normalize(s2, nbits, mask, 2 * n)
+    flag = _flag01(carry2)
+    hi1 = out1[:, n:]
+    hi2 = out2[:, n:]
+    return hi1 + (hi2 - hi1) * flag
+
+
 def _mont_core(k: _K, a, b):
     """Full Montgomery multiply in VMEM: canonical n-limb result
     (mirrors limb.mont_mul's separated-operand algorithm step for step)."""
@@ -197,44 +235,100 @@ def _mod_sub(k: _K, x, y):
     return out2 + (out1 - out2) * flag
 
 
+def _fp2_mul_math(k: _K, mont, a0, a1, b0, b1):
+    """Karatsuba Fp2 multiply on VMEM values: c0 = a0 b0 - a1 b1,
+    c1 = (a0+a1)(b0+b1) - a0 b0 - a1 b1. `mont` is the Montgomery core
+    (VPU or MXU-assisted)."""
+    ta = _mod_add(k, a0, a1)
+    tb = _mod_add(k, b0, b1)
+    v0 = mont(a0, b0)
+    v1 = mont(a1, b1)
+    s = mont(ta, tb)
+    return _mod_sub(k, v0, v1), _mod_sub(k, s, _mod_add(k, v0, v1))
+
+
+def _fp2_sqr_math(k: _K, mont, a0, a1):
+    """Fused Fp2 square: c0 = (a0+a1)(a0-a1), c1 = 2 a0 a1."""
+    ta = _mod_add(k, a0, a1)
+    ts = _mod_sub(k, a0, a1)
+    c0 = mont(ta, ts)
+    w = mont(a0, a1)
+    return c0, _mod_add(k, w, w)
+
+
 def _mont_kernel_body(ctx: ModCtx, a_ref, b_ref, consts_ref, out_ref):
     k = _unpack_consts(ctx, consts_ref)
     out_ref[:] = _mont_core(k, a_ref[:], b_ref[:])
+
+
+def _mont_mxu_kernel_body(
+    ctx: ModCtx, a_ref, b_ref, nT0, nT1, pT0, pT1, consts_ref, out_ref
+):
+    k = _unpack_consts(ctx, consts_ref)
+    out_ref[:] = _mont_core_mxu(
+        k, a_ref[:], b_ref[:], nT0[:], nT1[:], pT0[:], pT1[:]
+    )
 
 
 def _fp2_mul_kernel_body(
     ctx: ModCtx, a0_ref, a1_ref, b0_ref, b1_ref, consts_ref, c0_ref, c1_ref
 ):
     """Whole Karatsuba Fp2 multiply fused in VMEM: the prep sums, three
-    Montgomery multiplies, and the recombination never touch HBM —
-    c0 = a0 b0 - a1 b1, c1 = (a0+a1)(b0+b1) - a0 b0 - a1 b1.
+    Montgomery multiplies, and the recombination never touch HBM.
 
     This is the Miller loop's dominant op (~90% of pairing field work);
     the unfused path round-trips HBM between every stacked normalize and
     mont_mul (PERF.md 'Where the remaining gap is')."""
     k = _unpack_consts(ctx, consts_ref)
-    a0, a1, b0, b1 = a0_ref[:], a1_ref[:], b0_ref[:], b1_ref[:]
-    ta = _mod_add(k, a0, a1)
-    tb = _mod_add(k, b0, b1)
-    v0 = _mont_core(k, a0, b0)
-    v1 = _mont_core(k, a1, b1)
-    s = _mont_core(k, ta, tb)
-    c0_ref[:] = _mod_sub(k, v0, v1)
-    c1_ref[:] = _mod_sub(k, s, _mod_add(k, v0, v1))
+    mont = functools.partial(_mont_core, k)
+    c0_ref[:], c1_ref[:] = _fp2_mul_math(
+        k, mont, a0_ref[:], a1_ref[:], b0_ref[:], b1_ref[:]
+    )
+
+
+def _fp2_mul_mxu_kernel_body(
+    ctx: ModCtx,
+    a0_ref,
+    a1_ref,
+    b0_ref,
+    b1_ref,
+    nT0,
+    nT1,
+    pT0,
+    pT1,
+    consts_ref,
+    c0_ref,
+    c1_ref,
+):
+    """Fused Fp2 multiply with the constant convolutions of all three
+    inner Montgomery multiplies on the MXU — the int8 pieces never leave
+    VMEM (PERF.md int8-MXU lever, fold-into-Pallas step)."""
+    k = _unpack_consts(ctx, consts_ref)
+    mont = lambda x, y: _mont_core_mxu(  # noqa: E731
+        k, x, y, nT0[:], nT1[:], pT0[:], pT1[:]
+    )
+    c0_ref[:], c1_ref[:] = _fp2_mul_math(
+        k, mont, a0_ref[:], a1_ref[:], b0_ref[:], b1_ref[:]
+    )
 
 
 def _fp2_sqr_kernel_body(
     ctx: ModCtx, a0_ref, a1_ref, consts_ref, c0_ref, c1_ref
 ):
-    """Fused Fp2 square: c0 = (a0+a1)(a0-a1), c1 = 2 a0 a1 — two
-    Montgomery multiplies, all in VMEM."""
+    """Fused Fp2 square — two Montgomery multiplies, all in VMEM."""
     k = _unpack_consts(ctx, consts_ref)
-    a0, a1 = a0_ref[:], a1_ref[:]
-    ta = _mod_add(k, a0, a1)
-    ts = _mod_sub(k, a0, a1)
-    c0_ref[:] = _mont_core(k, ta, ts)
-    w = _mont_core(k, a0, a1)
-    c1_ref[:] = _mod_add(k, w, w)
+    mont = functools.partial(_mont_core, k)
+    c0_ref[:], c1_ref[:] = _fp2_sqr_math(k, mont, a0_ref[:], a1_ref[:])
+
+
+def _fp2_sqr_mxu_kernel_body(
+    ctx: ModCtx, a0_ref, a1_ref, nT0, nT1, pT0, pT1, consts_ref, c0_ref, c1_ref
+):
+    k = _unpack_consts(ctx, consts_ref)
+    mont = lambda x, y: _mont_core_mxu(  # noqa: E731
+        k, x, y, nT0[:], nT1[:], pT0[:], pT1[:]
+    )
+    c0_ref[:], c1_ref[:] = _fp2_sqr_math(k, mont, a0_ref[:], a1_ref[:])
 
 
 @functools.lru_cache(maxsize=None)
@@ -255,29 +349,43 @@ def _ctx_consts(ctx: ModCtx) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _mont_call(ctx: ModCtx, interpret: bool):
+def _toeplitz_consts(ctx: ModCtx):
+    """int8 Toeplitz piece matrices for the two constant convolutions
+    (shared geometry with ops/limb_mxu.py): (nT0, nT1) [n, n] for
+    -m^-1 mod R, (pT0, pT1) [n, 2n] for the modulus."""
+    from charon_tpu.ops.limb_mxu import _modulus_toeplitz, _ninv_toeplitz
+
+    nT0, nT1 = _ninv_toeplitz(ctx)
+    pT0, pT1 = _modulus_toeplitz(ctx)
+    return nT0, nT1, pT0, pT1
+
+
+def _mxu_usable(ctx: ModCtx) -> bool:
+    return ctx.limb_bits == 12 and ctx.np_dtype is np.uint32
+
+
+@functools.lru_cache(maxsize=None)
+def _mont_call(ctx: ModCtx, interpret: bool, mxu: bool = False):
     """Gridless pallas_call over one (TILE, n_limbs) block. Batches
     larger than TILE run it under lax.map — Mosaic on this platform
     fails to legalize block index maps (i64 returns), and a device-side
     map over a fixed-shape kernel compiles the kernel exactly once
     anyway."""
     n = ctx.n_limbs
-    kernel = functools.partial(_mont_kernel_body, ctx)
+    body = _mont_mxu_kernel_body if mxu else _mont_kernel_body
+    n_in = 7 if mxu else 3
+    kernel = functools.partial(body, ctx)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((TILE, n), jnp.uint32),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _fp2_call(ctx: ModCtx, kind: str, interpret: bool):
+def _fp2_call(ctx: ModCtx, kind: str, interpret: bool, mxu: bool = False):
     """Gridless pallas_call for the fused Fp2 kernels (same lax.map
     chunking strategy as the mont kernel)."""
     n = ctx.n_limbs
@@ -286,13 +394,13 @@ def _fp2_call(ctx: ModCtx, kind: str, interpret: bool):
         jax.ShapeDtypeStruct((TILE, n), jnp.uint32),
     )
     if kind == "mul":
-        body = functools.partial(_fp2_mul_kernel_body, ctx)
-        n_in = 5
+        body = _fp2_mul_mxu_kernel_body if mxu else _fp2_mul_kernel_body
+        n_in = 5 + (4 if mxu else 0)
     else:
-        body = functools.partial(_fp2_sqr_kernel_body, ctx)
-        n_in = 3
+        body = _fp2_sqr_mxu_kernel_body if mxu else _fp2_sqr_kernel_body
+        n_in = 3 + (4 if mxu else 0)
     return pl.pallas_call(
-        body,
+        functools.partial(body, ctx),
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in,
         out_specs=(
@@ -303,11 +411,30 @@ def _fp2_call(ctx: ModCtx, kind: str, interpret: bool):
     )
 
 
-def _run_fp2(ctx: ModCtx, kind: str, operands, interpret: bool):
+def _resolve_mxu(ctx: ModCtx, mxu: bool | None) -> bool:
+    """None = follow limb's MXU dispatch mode (CHARON_MXU_MONT /
+    limb.set_mxu); True/False = forced for this call."""
+    if mxu is None:
+        from charon_tpu.ops import limb as _limb
+
+        mxu = _limb._mxu_active(ctx)
+    return bool(mxu) and _mxu_usable(ctx)
+
+
+def _mxu_extras(ctx: ModCtx, mxu: bool) -> tuple:
+    if not mxu:
+        return ()
+    return tuple(jnp.asarray(T) for T in _toeplitz_consts(ctx))
+
+
+def _run_fp2(
+    ctx: ModCtx, kind: str, operands, interpret: bool, mxu: bool | None
+):
     """Flatten/pad a list of (..., n) operand arrays to TILE-row chunks
     and run the fused kernel; returns the two (..., n) outputs."""
     if ctx.np_dtype is not np.uint32:
         raise ValueError("pallas fp2 kernels require the uint32 limb geometry")
+    mxu = _resolve_mxu(ctx, mxu)
     operands = jnp.broadcast_arrays(*operands)
     batch_shape = operands[0].shape[:-1]
     n = ctx.n_limbs
@@ -316,14 +443,15 @@ def _run_fp2(ctx: ModCtx, kind: str, operands, interpret: bool):
     padded = -(-rows // TILE) * TILE
     if padded != rows:
         flats = [jnp.pad(f, ((0, padded - rows), (0, 0))) for f in flats]
+    extras = _mxu_extras(ctx, mxu)
     consts = jnp.asarray(_ctx_consts(ctx))
-    call = _fp2_call(ctx, kind, interpret)
+    call = _fp2_call(ctx, kind, interpret, mxu)
     if padded == TILE:
-        c0, c1 = call(*flats, consts)
+        c0, c1 = call(*flats, *extras, consts)
     else:
         chunks = padded // TILE
         c0, c1 = jax.lax.map(
-            lambda xs: call(*xs, consts),
+            lambda xs: call(*xs, *extras, consts),
             tuple(f.reshape(chunks, TILE, n) for f in flats),
         )
         c0 = c0.reshape(padded, n)
@@ -334,23 +462,30 @@ def _run_fp2(ctx: ModCtx, kind: str, operands, interpret: bool):
     )
 
 
-def fp2_mul_pallas(ctx: ModCtx, a, b, interpret: bool = False):
+def fp2_mul_pallas(
+    ctx: ModCtx, a, b, interpret: bool = False, mxu: bool | None = None
+):
     """Fused Fp2 Karatsuba multiply: a, b are (c0, c1) tuples of reduced
     Montgomery limb arrays; returns the product tuple. Drop-in for
     ops/fptower.fp2_mul on the uint32 geometry."""
-    return _run_fp2(ctx, "mul", (a[0], a[1], b[0], b[1]), interpret)
+    return _run_fp2(ctx, "mul", (a[0], a[1], b[0], b[1]), interpret, mxu)
 
 
-def fp2_sqr_pallas(ctx: ModCtx, a, interpret: bool = False):
+def fp2_sqr_pallas(
+    ctx: ModCtx, a, interpret: bool = False, mxu: bool | None = None
+):
     """Fused Fp2 square; drop-in for ops/fptower.fp2_sqr."""
-    return _run_fp2(ctx, "sqr", (a[0], a[1]), interpret)
+    return _run_fp2(ctx, "sqr", (a[0], a[1]), interpret, mxu)
 
 
-def mont_mul_pallas(ctx: ModCtx, a, b, interpret: bool = False):
+def mont_mul_pallas(
+    ctx: ModCtx, a, b, interpret: bool = False, mxu: bool | None = None
+):
     """Drop-in for limb.mont_mul on the uint32 geometry: reduced
     Montgomery-form inputs with arbitrary broadcastable batch dims."""
     if ctx.np_dtype is not np.uint32:
         raise ValueError("pallas mont_mul requires the uint32 limb geometry")
+    mxu = _resolve_mxu(ctx, mxu)
     a, b = jnp.broadcast_arrays(a, b)
     batch_shape = a.shape[:-1]
     n = ctx.n_limbs
@@ -362,14 +497,15 @@ def mont_mul_pallas(ctx: ModCtx, a, b, interpret: bool = False):
         pad = ((0, padded - rows), (0, 0))
         flat_a = jnp.pad(flat_a, pad)
         flat_b = jnp.pad(flat_b, pad)
+    extras = _mxu_extras(ctx, mxu)
     consts = jnp.asarray(_ctx_consts(ctx))
-    call = _mont_call(ctx, interpret)
+    call = _mont_call(ctx, interpret, mxu)
     if padded == TILE:
-        out = call(flat_a, flat_b, consts)
+        out = call(flat_a, flat_b, *extras, consts)
     else:
         chunks = padded // TILE
         out = jax.lax.map(
-            lambda ab: call(ab[0], ab[1], consts),
+            lambda ab: call(ab[0], ab[1], *extras, consts),
             (
                 flat_a.reshape(chunks, TILE, n),
                 flat_b.reshape(chunks, TILE, n),

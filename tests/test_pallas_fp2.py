@@ -153,3 +153,25 @@ def test_fp2_batch_pallas_dispatch_matches_xla():
     assert len(got) == len(want)
     for i, (g, w) in enumerate(zip(got, want)):
         _assert_fp2_equal(g, w, f"op{i}")
+
+
+def test_fp2_mxu_variants_match_xla():
+    """MXU-fused fp2 kernels (Toeplitz int8 matmuls inside the fused
+    multiply) are bit-identical to the XLA tower and the VPU kernels."""
+    rng = random.Random(29)
+    a, b = _rand_fp2(rng, 8), _rand_fp2(rng, 8)
+    _assert_fp2_equal(
+        PK.fp2_mul_pallas(CTX, a, b, interpret=True, mxu=True),
+        T.fp2_mul(CTX, a, b),
+        "mul-mxu",
+    )
+    _assert_fp2_equal(
+        PK.fp2_sqr_pallas(CTX, a, interpret=True, mxu=True),
+        T.fp2_sqr(CTX, a),
+        "sqr-mxu",
+    )
+    _assert_fp2_equal(
+        PK.fp2_mul_pallas(CTX, a, b, interpret=True, mxu=True),
+        PK.fp2_mul_pallas(CTX, a, b, interpret=True, mxu=False),
+        "mul-mxu-vs-vpu",
+    )

@@ -91,3 +91,68 @@ def test_pallas_rejects_u64_geometry():
             jnp.zeros((4, 16), jnp.uint64),
             interpret=True,
         )
+
+
+def test_pallas_mxu_matches_vpu_kernel():
+    """The MXU-fused kernel (Toeplitz int8 matmuls issued in VMEM) is
+    bit-identical to the VPU Pallas kernel and the jnp engine
+    (interpret mode; ops/pallas_mont.py _mont_core_mxu)."""
+    ctx = limb.FP32
+    rng = random.Random(13)
+    vals_a = [rng.randrange(ctx.modulus) for _ in range(8)]
+    vals_b = [rng.randrange(ctx.modulus) for _ in range(8)]
+    edge = [0, 1, ctx.modulus - 1, ctx.modulus - 2]
+    a = jnp.asarray(limb.pack_mont_host(ctx, vals_a + edge))
+    b = jnp.asarray(limb.pack_mont_host(ctx, vals_b + list(reversed(edge))))
+    got = mont_mul_pallas(ctx, a, b, interpret=True, mxu=True)
+    vpu = mont_mul_pallas(ctx, a, b, interpret=True, mxu=False)
+    want = limb.mont_mul(ctx, a, b)
+    assert np.array_equal(np.asarray(got), np.asarray(vpu))
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_mxu_multi_chunk():
+    """MXU kernel under the lax.map chunking path (rows > TILE)."""
+    from charon_tpu.ops.pallas_mont import TILE
+
+    ctx = limb.FP32
+    rng = random.Random(14)
+    rows = TILE + 3
+    vals_a = [rng.randrange(ctx.modulus) for _ in range(rows)]
+    vals_b = [rng.randrange(ctx.modulus) for _ in range(rows)]
+    a = jnp.asarray(limb.pack_mont_host(ctx, vals_a))
+    b = jnp.asarray(limb.pack_mont_host(ctx, vals_b))
+    got = mont_mul_pallas(ctx, a, b, interpret=True, mxu=True)
+    want = limb.mont_mul(ctx, a, b)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_mxu_dispatch_via_limb(monkeypatch):
+    """limb.mont_mul with BOTH mxu and pallas active routes through the
+    fused pallas-mxu kernel (not the XLA-level lowering) and matches it."""
+    ctx = limb.FP32
+    rng = random.Random(15)
+    vals = [rng.randrange(ctx.modulus) for _ in range(4)]
+    a = jnp.asarray(limb.pack_mont_host(ctx, vals))
+    b = jnp.asarray(limb.pack_mont_host(ctx, list(reversed(vals))))
+    want = np.asarray(limb.mont_mul(ctx, a, b))
+
+    calls = {}
+    import charon_tpu.ops.pallas_mont as pm
+
+    real = pm.mont_mul_pallas
+
+    def spy(ctx_, a_, b_, interpret=False, mxu=None):
+        calls["mxu"] = mxu
+        return real(ctx_, a_, b_, interpret=True, mxu=mxu)
+
+    monkeypatch.setattr(pm, "mont_mul_pallas", spy)
+    limb.set_mxu(True)
+    limb.set_pallas(True)
+    try:
+        got = limb.mont_mul(ctx, a, b)
+    finally:
+        limb.set_mxu(None)
+        limb.set_pallas(None)
+    assert calls["mxu"] is True
+    assert np.array_equal(np.asarray(got), want)
