@@ -1,0 +1,20 @@
+# Long-window axon claim probe: is the tunnel wedged or just cold?
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "axon,cpu")
+t0 = time.time()
+print(f"[probe] importing jax at t=0", flush=True)
+import jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+print(f"[probe] jax imported at t={time.time()-t0:.1f}s; claiming devices...", flush=True)
+d = jax.devices()
+print(f"[probe] CLAIMED at t={time.time()-t0:.1f}s: {d}", flush=True)
+if d[0].platform == "cpu":
+    # axon plugin failed fast and jax fell back to CPU — NOT a recovered
+    # tunnel; the watcher must keep waiting, not run the suite on CPU
+    print("[probe] claimed platform is cpu, not the TPU: FAIL", flush=True)
+    sys.exit(1)
+import numpy as np
+x = jax.numpy.ones((256, 256))
+y = (x @ x).block_until_ready()
+print(f"[probe] matmul done at t={time.time()-t0:.1f}s, sum={float(y.sum())}", flush=True)

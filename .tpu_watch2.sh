@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-4 claim-watcher: the relay PORT answering is not enough (rounds
+# 3-4 saw open ports with the PJRT claim wedged), so probe the actual
+# device claim in a subprocess with a generous timeout; on success run
+# the full bench ladder + slot-step bench. Logs to bench_r4_auto.log.
+log=/root/repo/bench_r4_auto.log
+cd /root/repo
+attempt=0
+while true; do
+  attempt=$((attempt+1))
+  echo "[watch2 $(date +%H:%M:%S)] claim attempt $attempt (timeout 900s)" >> "$log"
+  if timeout 900 python .claim_probe.py >> .claim_probe.log 2>&1; then
+    echo "[watch2 $(date +%H:%M:%S)] CLAIM OK - launching bench ladder" >> "$log"
+    BENCH_BATCHES="4096 2048 1024 512 256" python bench.py >> /root/repo/bench_r4_auto.out 2>> "$log"
+    echo "[watch2 $(date +%H:%M:%S)] bench exited rc=$?" >> "$log"
+    python bench_slotstep.py >> /root/repo/bench_r4_auto.out 2>> "$log"
+    echo "[watch2 $(date +%H:%M:%S)] slotstep exited rc=$?" >> "$log"
+    BENCH_MXU=1 python bench.py >> /root/repo/bench_r4_auto.out 2>> "$log"
+    echo "[watch2 $(date +%H:%M:%S)] mxu bench exited rc=$?" >> "$log"
+    python bench_dkg.py >> /root/repo/bench_r4_auto.out 2>> "$log"
+    echo "[watch2 $(date +%H:%M:%S)] dkg bench exited rc=$?" >> "$log"
+    echo "[watch2 $(date +%H:%M:%S)] full suite done" >> "$log"
+    exit 0
+  fi
+  echo "[watch2 $(date +%H:%M:%S)] claim attempt $attempt failed/hung" >> "$log"
+  sleep 60
+done

@@ -1,0 +1,155 @@
+"""DKG crypto benchmark — BASELINE config 4: batched G1 scalar-muls.
+
+FROST ceremony verification is dominated by [k]P over G1: every
+(peer, validator, coefficient) commitment check is one scalar-mul
+(charon_tpu/dkg/frost.py verify paths; ref: dkg/frost.go runs them one
+kryptology call at a time per ceremony). Here the whole verification
+wave runs as ONE device program via blsops.g1_scalar_mul_batch.
+
+Prints ONE JSON line: {"metric": "dkg_g1_scalar_mul", "value": N,
+"unit": "muls/sec", "vs_baseline": R, ...}. vs_baseline divides by the
+HOST native C++ backend's single-threaded scalar-mul rate measured in
+the same run (the herumi-role reference on this machine) — honest on
+any host, no canned constant.
+
+Batch ladder: BENCH_DKG_BATCHES (space-separated), default TPU profile
+4096/1024/256 muls, CPU-fallback profile 64 (compile cost on the 1-core
+VM; liveness datapoint, not the headline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+WARMUP = 4
+ITERS = 3
+
+T0 = time.perf_counter()
+
+
+def hb(msg: str) -> None:
+    print(f"[dkg-bench +{time.perf_counter() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from bench_common import init_jax_with_watchdog
+
+    jax = init_jax_with_watchdog("dkg_g1_scalar_mul", "muls/sec")
+    platform = jax.devices()[0].platform
+    if "BENCH_DKG_BATCHES" in os.environ and not (
+        platform == "cpu" and os.environ.get("CHARON_BENCH_TUNNEL")
+    ):
+        batches = [int(b) for b in os.environ["BENCH_DKG_BATCHES"].split()]
+    elif platform != "cpu":
+        batches = [4096, 1024, 256]
+    else:
+        batches = [64]
+    hb(f"jax up, platform={platform}, batches={batches}")
+
+    from charon_tpu.crypto.g1g2 import G1_GEN, g1_from_bytes, g1_mul
+    from charon_tpu.crypto.fields import R as FR_ORDER
+    from charon_tpu.ops.blsops import BlsEngine
+
+    # Host workload: random base points from the native backend (the
+    # same role herumi plays for the reference's DKG).
+    rng = random.Random(2026)
+    nmax = max(batches)
+    try:
+        from charon_tpu.tbls.native_impl import NativeImpl
+
+        impl = NativeImpl()
+        t = time.perf_counter()
+        bases = [
+            g1_from_bytes(
+                impl.secret_to_public_key(
+                    rng.randrange(1, FR_ORDER).to_bytes(32, "big")
+                )
+            )
+            for _ in range(nmax)
+        ]
+        hb(f"native backend built {nmax} base points in {time.perf_counter() - t:.1f}s")
+
+        # CPU denominator: native single-threaded [k]P rate
+        t = time.perf_counter()
+        n_ref = 32
+        for i in range(n_ref):
+            impl.secret_to_public_key(
+                rng.randrange(1, FR_ORDER).to_bytes(32, "big")
+            )
+        cpu_rate = n_ref / (time.perf_counter() - t)
+        hb(f"host native scalar-mul rate: {cpu_rate:.0f}/s")
+    except Exception as e:  # pure-Python fallback keeps the line parseable
+        hb(f"native backend unavailable ({e}); python fallback (slow)")
+        bases = [g1_mul(G1_GEN, rng.randrange(1, FR_ORDER)) for _ in range(nmax)]
+        cpu_rate = 0.0
+
+    scalars = [rng.randrange(1, FR_ORDER) for _ in range(nmax)]
+    engine = BlsEngine()
+
+    engine.g1_scalar_mul_batch(bases[:WARMUP], scalars[:WARMUP])
+    hb(f"warmup batch={WARMUP} done")
+
+    batch = None
+    for attempt in batches:
+        try:
+            t = time.perf_counter()
+            engine.g1_scalar_mul_batch(bases[:attempt], scalars[:attempt])
+            hb(f"batch={attempt} compile+run {time.perf_counter() - t:.1f}s")
+            batch = attempt
+            break
+        except Exception as e:
+            hb(f"batch={attempt} unusable ({type(e).__name__}: {str(e)[:100]})")
+    if batch is None:
+        raise RuntimeError("no batch size compiled successfully")
+
+    times = []
+    for i in range(ITERS):
+        t = time.perf_counter()
+        out = engine.g1_scalar_mul_batch(bases[:batch], scalars[:batch])
+        times.append(time.perf_counter() - t)
+        hb(f"iter {i}: {times[-1]:.3f}s")
+    # spot-check one lane against the host oracle
+    k = rng.randrange(batch)
+    assert out[k] == g1_mul(bases[k], scalars[k]), "device result != host oracle"
+
+    best = min(times)
+    rate = batch / best
+    hb(f"batch={batch} best {best:.3f}s -> {rate:.0f} muls/sec")
+    out_line = {
+        "metric": "dkg_g1_scalar_mul",
+        "value": round(rate, 2),
+        "unit": "muls/sec",
+        "vs_baseline": round(rate / cpu_rate, 4) if cpu_rate else 0.0,
+        "platform": platform,
+        "batch": batch,
+        "host_native_rate": round(cpu_rate, 2),
+    }
+    tunnel_state = os.environ.get("CHARON_BENCH_TUNNEL", "")
+    if tunnel_state:
+        out_line["note"] = (
+            f"TPU tunnel {tunnel_state}; XLA:CPU fallback measurement, "
+            "not the TPU headline"
+        )
+    print(json.dumps(out_line))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(
+            json.dumps(
+                {
+                    "metric": "dkg_g1_scalar_mul",
+                    "value": 0.0,
+                    "unit": "muls/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            )
+        )
+        sys.exit(0)

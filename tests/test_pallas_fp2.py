@@ -147,7 +147,11 @@ def test_fp2_batch_pallas_dispatch_matches_xla():
     # route through _fp2_batch_pallas with interpret-mode kernels
     orig_call = PK._fp2_call
     with mock.patch.object(
-        PK, "_fp2_call", lambda ctx, kind, interpret: orig_call(ctx, kind, True)
+        PK,
+        "_fp2_call",
+        lambda ctx, kind, interpret, mxu=False: orig_call(
+            ctx, kind, True, mxu
+        ),
     ):
         got = T._fp2_batch_pallas(CTX, ops)
     assert len(got) == len(want)
