@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 watcher, phase 2: the chip claim wedges for a while after any
+# process disconnects (observed 03:33 - claim hung >300s right after
+# bench.py exited rc=0), so gate EACH bench behind its own fresh claim
+# probe instead of only the first. Runs the remaining suite:
+# slot-step bench, BENCH_MXU A/B, DKG bench. Logs to bench_r4_auto.log.
+log=/root/repo/bench_r4_auto.log
+out=/root/repo/bench_r4_auto.out
+cd /root/repo
+
+run_gated() {
+  name="$1"; shift
+  attempt=0
+  while true; do
+    attempt=$((attempt+1))
+    echo "[watch3 $(date +%H:%M:%S)] $name: claim attempt $attempt (timeout 900s)" >> "$log"
+    if timeout 900 python .claim_probe.py >> .claim_probe.log 2>&1; then
+      echo "[watch3 $(date +%H:%M:%S)] $name: claim ok, running" >> "$log"
+      "$@" >> "$out" 2>> "$log"
+      echo "[watch3 $(date +%H:%M:%S)] $name exited rc=$?" >> "$log"
+      return 0
+    fi
+    echo "[watch3 $(date +%H:%M:%S)] $name: claim failed/hung, retry in 60s" >> "$log"
+    sleep 60
+  done
+}
+
+run_gated slotstep python bench_slotstep.py
+run_gated mxu_ab env BENCH_MXU=1 BENCH_BATCHES=4096 python bench.py
+run_gated dkg python bench_dkg.py
+echo "[watch3 $(date +%H:%M:%S)] full suite done" >> "$log"

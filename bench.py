@@ -63,7 +63,10 @@ def pick_batches(platform: str) -> list[int]:
     if "BENCH_BATCHES" in os.environ and not (platform == "cpu" and tunnel_fallback):
         return [int(b) for b in os.environ["BENCH_BATCHES"].split()]
     if platform != "cpu":
-        return [1024, 512, 256]
+        # 4096 first: measured 1664 sigs/s (2.50x envelope) on TPU v5
+        # lite 2026-07-31 and its compile is in the persistent cache;
+        # the smaller rungs only catch a cache wipe + compiler regression
+        return [4096, 2048, 1024, 512, 256]
     # a BENCH_BATCHES meant for the TPU sweep must not leak through the
     # dead-tunnel CPU re-exec: batch 4096 on XLA:CPU compiles for hours
     return [int(b) for b in os.environ.get("BENCH_BATCHES_CPU", "16").split()]

@@ -31,3 +31,19 @@ def test_supervisor_reports_crashed_child():
     assert "crashed twice" in out["error"]
     # both attempts visible in the supervisor's heartbeat stream
     assert proc.stderr.count("died rc=") == 2
+
+
+def test_claim_retry_env_ladder():
+    """A wedged TPU claim re-execs for fresh TPU attempts and only the
+    exhausted ladder pins to CPU (round-4: the wedge is transient, so a
+    single-attempt CPU pin would trade the TPU headline for a smoke
+    number on the driver run)."""
+    import bench_common
+
+    assert bench_common.CLAIM_ATTEMPTS >= 2
+    for attempt in range(1, bench_common.CLAIM_ATTEMPTS):
+        env = bench_common.claim_retry_env(attempt)
+        assert env == {"CHARON_BENCH_CLAIM_ATTEMPT": str(attempt + 1)}
+    final = bench_common.claim_retry_env(bench_common.CLAIM_ATTEMPTS)
+    assert final["CHARON_BENCH_FORCE_CPU"] == "1"
+    assert final["CHARON_BENCH_TUNNEL"] == "wedged"
