@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-4 watcher, phase 3 (post-MSM): claim-gate each bench (the chip
+# claim wedges for a while after any disconnect), run the measurement
+# queue in value order: MSM headline first, then the A/B and the rest.
+log=/root/repo/bench_r4_auto.log
+out=/root/repo/bench_r4_auto.out
+cd /root/repo
+
+run_gated() {
+  name="$1"; shift
+  attempt=0
+  while true; do
+    attempt=$((attempt+1))
+    echo "[watch4 $(date +%H:%M:%S)] $name: claim attempt $attempt (timeout 900s)" >> "$log"
+    if timeout 900 python .claim_probe.py >> .claim_probe.log 2>&1; then
+      echo "[watch4 $(date +%H:%M:%S)] $name: claim ok, running" >> "$log"
+      "$@" >> "$out" 2>> "$log"
+      echo "[watch4 $(date +%H:%M:%S)] $name exited rc=$?" >> "$log"
+      return 0
+    fi
+    echo "[watch4 $(date +%H:%M:%S)] $name: claim failed/hung, retry in 60s" >> "$log"
+    sleep 60
+  done
+}
+
+run_gated msm_headline env BENCH_BATCHES=4096 python bench.py
+run_gated msm_wide env BENCH_BATCHES="16384 8192" python bench.py
+run_gated breakdown python bench_breakdown.py
+run_gated slotstep python bench_slotstep.py
+run_gated mxu_ab env BENCH_MXU=1 BENCH_BATCHES=4096 python bench.py
+run_gated dkg python bench_dkg.py
+echo "[watch4 $(date +%H:%M:%S)] full suite done" >> "$log"
