@@ -181,6 +181,11 @@ def main() -> None:
         limb.set_mxu(True)
         FT.set_fp2_fusion(False)
 
+    from charon_tpu.ops import msm as MSM
+
+    def _rung_msm_off():
+        MSM.set_msm(False)
+
     def _rung_fp2_off():
         FT.set_fp2_fusion(False)
 
@@ -194,15 +199,44 @@ def main() -> None:
     # kernel (fusion is already off), but pallas-off stays meaningful:
     # once mxu steps down, mont_mul dispatches to the Pallas kernel and
     # a Mosaic regression there still needs the pure-XLA floor
-    rungs = (
-        [("without mxu", _rung_mxu_off), ("without pallas", _rung_pallas_off)]
-        if bench_mxu
-        else [
-            ("without fp2 fusion", _rung_fp2_off),
-            ("without pallas", _rung_pallas_off),
-        ]
-    )
-    state = {"kernel": make_kernel(), "rungs": rungs}
+    # "without msm" first: the Pippenger randomization stage is the
+    # newest kernel family — a compiler regression there falls back to
+    # the proven per-lane double-and-add (the round-4 1664 sigs/s path)
+    def apply_baseline():
+        """Restore the full fast path. Called before every batch attempt
+        so a SIZE-induced failure (e.g. OOM at 16384) cannot burn rungs
+        that then silently degrade the smaller batch's measurement."""
+        MSM.set_msm(None)
+        limb.set_pallas(None)
+        if bench_mxu:
+            limb.set_mxu(True)
+            FT.set_fp2_fusion(False)
+        else:
+            limb.set_mxu(None)
+            FT.set_fp2_fusion(True)
+
+    def fresh_rungs():
+        return (
+            [
+                ("without msm", _rung_msm_off),
+                ("without mxu", _rung_mxu_off),
+                ("without pallas", _rung_pallas_off),
+            ]
+            if bench_mxu
+            else [
+                ("without msm", _rung_msm_off),
+                ("without fp2 fusion", _rung_fp2_off),
+                ("without pallas", _rung_pallas_off),
+            ]
+        )
+
+    state = {"kernel": make_kernel(), "rungs": fresh_rungs(), "used": []}
+
+    def reset_ladder():
+        apply_baseline()
+        state["kernel"] = make_kernel()
+        state["rungs"] = fresh_rungs()
+        state["used"] = []
 
     def run_verify(args, label: str):
         """Run the kernel; on failure step down the degradation ladder
@@ -224,6 +258,7 @@ def main() -> None:
                     f"retrying {rung_name}"
                 )
                 apply()
+                state["used"].append(rung_name)
                 state["kernel"] = make_kernel()
         assert bool(ok), f"{label} batch verification failed"
         return ok
@@ -238,6 +273,7 @@ def main() -> None:
             # with K = attempt // n_msgs, so a non-multiple batch would
             # otherwise silently verify fewer sigs than reported
             actual = min(n_msgs, attempt) * (attempt // min(n_msgs, attempt))
+            reset_ladder()
             packed = pack(attempt)
             run_verify(packed, f"main batch={actual}")
             batch = actual
@@ -271,6 +307,11 @@ def main() -> None:
         "platform": platform,
         "batch": batch,
     }
+    if state["used"]:
+        # rungs burned while measuring THIS batch — the number is a
+        # degraded-path measurement, never silently presented as the
+        # full fast path
+        out["degraded"] = state["used"]
     tunnel_state = os.environ.get("CHARON_BENCH_TUNNEL", "")
     if tunnel_state:
         out["note"] = (

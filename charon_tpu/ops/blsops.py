@@ -95,9 +95,10 @@ def clear_kernel_caches() -> None:
     """Drop every cached jitted kernel so the next call RE-TRACES.
 
     The degradation ladders (bench.py, tbls/tpu_impl.py) flip trace-time
-    routing flags (fptower.set_fp2_fusion, limb.set_pallas); without
-    this, the lru-cached jit wrappers keep returning the already-compiled
-    executable and the flag flip never takes effect."""
+    routing flags (fptower.set_fp2_fusion, limb.set_pallas, limb.set_mxu,
+    msm.set_msm); without this, the lru-cached jit wrappers — including
+    _threshold_agg_kernel's Straus/per-lane routing — keep returning the
+    already-compiled executable and the flag flip never takes effect."""
     import sys
 
     mod = sys.modules[__name__]
@@ -115,8 +116,15 @@ def _threshold_agg_kernel(ctx: ModCtx, fr_ctx: ModCtx, t: int):
         # sig_affine: affine G2 with batch shape (V, t); idx: (V, t) int32
         coeffs = lagrange_coeffs_at_zero(fr_ctx, idx, t)  # (V, t, L)
         proj = C.affine_to_point(f, sig_affine)
-        scaled = C.point_scalar_mul(f, fr_ctx, proj, coeffs)
-        total = C.point_sum(f, scaled, axis=-1)  # reduce the t axis
+        from charon_tpu.ops import msm as MSM
+
+        if MSM.msm_active():
+            # Straus joint windowed mul: one shared doubling chain per
+            # validator instead of t per-lane 255-bit double-and-adds
+            total = MSM.windowed_joint_mul(f, fr_ctx, proj, coeffs)
+        else:
+            scaled = C.point_scalar_mul(f, fr_ctx, proj, coeffs)
+            total = C.point_sum(f, scaled, axis=-1)  # reduce the t axis
         return C.point_to_affine(f, total)
 
     return jax.jit(kernel)

@@ -1,0 +1,249 @@
+"""Batched multi-scalar multiplication: sorted-bucket Pippenger on device.
+
+The grouped-RLC verify kernel's dominant stage is the per-lane
+randomization: one 64-bit G1 and one 64-bit G2 scalar multiplication per
+signature lane (`curve.point_scalar_mul` — ~64 doublings + 64 selected
+additions each). At production batch sizes that stage is >99% of the
+field arithmetic (the Miller/final-exp tail is fixed per distinct
+message). Pippenger's bucket method shares that work ACROSS lanes: for
+each w-bit window of the scalars, lanes with equal digits collapse into
+one bucket sum, and the per-window bucket tables combine with
+~2^w + w point-ops regardless of lane count. Total point-ops drop from
+~2·nbits per lane to ~2·(nbits/w) per lane plus a fixed tail — ~8x
+fewer at w = 8, nbits = 64.
+
+TPU-first shape of the classic algorithm (GPU MSM implementations use
+scatter-add into bucket memory; XLA wants batched dense ops instead):
+
+  1. digits: [N, n_win] w-bit windows of the raw scalars;
+  2. ONE flat element list over (window, lane) with composite sort key
+     key = (window, segment, digit); `jnp.argsort` groups equal buckets
+     into contiguous runs;
+  3. a segmented inclusive scan (`lax.associative_scan` over the sorted
+     points with a key-equality combine) reduces every run with
+     complete-formula point adds — log-depth, fully batched, branch-free;
+  4. the last element of each run is scattered into a dense
+     [n_win, n_segments, 2^w] bucket table (unique targets — the scatter
+     is deterministic); digit-0 buckets are dropped;
+  5. the standard suffix-sum turns each window's buckets into
+     sum_b b*B_b (one lax.scan, batched over windows x segments);
+  6. Horner across windows: acc = 2^w*acc + W_win.
+
+Complete projective formulas (curve.point_add) make every step total:
+identity padding lanes, zero scalars, repeated points, and empty buckets
+all flow through the same straight-line code — no data-dependent
+branches anywhere, exactly what XLA needs (SURVEY.md design stance).
+
+Segments: the grouped-RLC layout needs per-message G1 bucket sums, so
+the kernel reduces into `segment_ids` partitions in the same sort (a
+segment is just more key bits). The G2 aggregate is the n_segments = 1
+case.
+
+ref: core/sigagg/sigagg.go:84-122 is the reference's per-signature hot
+path this batching replaces; the RLC construction itself is in
+ops/pairing.py batched_verify_grouped_rlc.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import limb
+from charon_tpu.ops.curve import FieldOps
+from charon_tpu.ops.limb import ModCtx
+
+_tree = jax.tree_util.tree_map
+
+
+def _digits(fr_ctx: ModCtx, scalars, nbits: int, window: int):
+    """Raw Fr limb array [..., n_limbs] -> [..., n_win] w-bit digits,
+    little-endian windows (window 0 = least significant)."""
+    shifts = jnp.arange(fr_ctx.limb_bits, dtype=scalars.dtype)
+    bits = (scalars[..., None] >> shifts) & fr_ctx.u(1)
+    bits = bits.reshape(*scalars.shape[:-1], -1)[..., :nbits]
+    n_win = -(-nbits // window)
+    pad = n_win * window - nbits
+    if pad:
+        bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+    bits = bits.reshape(*bits.shape[:-1], n_win, window).astype(jnp.int32)
+    weights = (1 << jnp.arange(window, dtype=jnp.int32))[None, :]
+    return jnp.sum(bits * weights, axis=-1)  # [..., n_win]
+
+
+def msm_segmented(
+    f: FieldOps,
+    fr_ctx: ModCtx,
+    points,
+    scalars,
+    segment_ids,
+    n_segments: int,
+    nbits: int = 64,
+    window: int = 8,
+):
+    """sum_{i: segment_ids[i] == s} scalars[i] * points[i] for each s.
+
+    points: projective pytree with leading batch axis [N]; scalars: raw
+    (non-Montgomery) Fr limbs [N, n_limbs]; segment_ids: int32 [N] in
+    [0, n_segments). Returns a projective pytree with batch [n_segments].
+    """
+    n = segment_ids.shape[0]
+    n_win = -(-nbits // window)
+    n_buckets = 1 << window
+
+    digits = _digits(fr_ctx, scalars, nbits, window)  # [N, n_win]
+    # flat element e = win * N + i (window-major so point index = e % N)
+    win_idx = jnp.repeat(jnp.arange(n_win, dtype=jnp.int32), n)
+    seg_flat = jnp.tile(segment_ids.astype(jnp.int32), n_win)
+    digit_flat = digits.T.reshape(-1)  # [n_win * N]
+    key = (win_idx * n_segments + seg_flat) * n_buckets + digit_flat
+
+    order = jnp.argsort(key)
+    key_sorted = key[order]
+    pts_sorted = _tree(lambda a: a[order % n], points)
+
+    def comb(a, b):
+        pa, ka = a
+        pb, kb = b
+        return (
+            C.point_select(f, ka == kb, C.point_add(f, pa, pb), pb),
+            kb,
+        )
+
+    scanned, _ = lax.associative_scan(comb, (pts_sorted, key_sorted))
+
+    # run tails -> dense bucket table (unique targets: deterministic set)
+    table_size = n_win * n_segments * n_buckets
+    last = jnp.concatenate(
+        [key_sorted[1:] != key_sorted[:-1], jnp.array([True])]
+    )
+    target = jnp.where(last, key_sorted, table_size)  # non-tails -> trash
+
+    identity_table = C.point_identity(f, (table_size + 1,))
+    table = _tree(
+        lambda init, v: init.at[target].set(v), identity_table, scanned
+    )
+    table = _tree(
+        lambda a: a[:table_size].reshape(
+            n_win, n_segments, n_buckets, *a.shape[1:]
+        ),
+        table,
+    )
+    # drop digit-0 buckets, reverse for the suffix scan (b = 2^w-1 .. 1)
+    buckets = _tree(lambda a: jnp.flip(a[:, :, 1:], axis=2), table)
+
+    def wstep(carry, bucket_b):  # bucket_b batched over [n_win, n_segments]
+        running, acc = carry
+        running = C.point_add(f, running, bucket_b)
+        acc = C.point_add(f, acc, running)
+        return (running, acc), None
+
+    # scan carries must inherit the inputs' shard_map varying axes
+    template = jax.tree_util.tree_leaves(buckets)[0][:, :, 0]
+    init = (
+        C.point_identity(f, (n_win, n_segments)),
+        C.point_identity(f, (n_win, n_segments)),
+    )
+    init = _tree(lambda a: limb.match_vary(a, template), init)
+    xs = _tree(lambda a: jnp.moveaxis(a, 2, 0), buckets)
+    (_, windows), _ = lax.scan(wstep, init, xs)  # [n_win, n_segments]
+
+    # Horner across windows, most significant first: acc = 2^w acc + W
+    acc = _tree(lambda a: a[n_win - 1], windows)
+    for win in range(n_win - 2, -1, -1):
+        for _ in range(window):
+            acc = C.point_double(f, acc)
+        acc = C.point_add(f, acc, _tree(lambda a: a[win], windows))
+    return acc
+
+
+def windowed_joint_mul(
+    f: FieldOps,
+    fr_ctx: ModCtx,
+    points,
+    scalars,
+    nbits: int = 255,
+    window: int = 4,
+):
+    """out[v] = sum_j scalars[v, j] * points[v, j] — the threshold-
+    recombination shape: per validator, t share signatures scaled by
+    255-bit Lagrange coefficients and summed.
+
+    Pippenger needs many lanes per bucket; with only t (4..7) lanes per
+    segment its bucket tables would be nearly empty, so this path uses
+    the other classic batching — Straus/windowed joint multiplication:
+    per-lane tables of the first 2^w multiples, then ONE shared
+    doubling chain per validator with t table-gather adds per window.
+    Point-ops per validator drop from t * 2 * nbits (per-lane
+    double-and-add) to ~(nbits/w) * (w + t) — ~4x at t = 4, w = 4.
+
+    points: projective pytree with batch (V, t); scalars raw Fr limbs
+    (V, t, n_limbs). Returns a projective pytree with batch (V,).
+    """
+    digits = _digits(fr_ctx, scalars, nbits, window)  # (V, t, n_win)
+    n_win = digits.shape[-1]
+    t = digits.shape[-2]
+
+    # per-lane multiple tables: T[d] = d * P, d in 0..2^w-1
+    multiples = [C.point_identity(f, digits.shape[:-1]), points]
+    for _ in range(2, 1 << window):
+        multiples.append(C.point_add(f, multiples[-1], points))
+    table = _tree(lambda *xs: jnp.stack(xs, axis=2), *multiples)
+    # leaves: (V, t, 2^w, ...)
+
+    template = jax.tree_util.tree_leaves(table)[0][:, 0, 0]
+    init = _tree(
+        lambda a: limb.match_vary(a, template),
+        C.point_identity(f, digits.shape[:-2]),
+    )
+
+    def body(acc, digit_vt):  # digit_vt: (V, t), MSB window first
+        for _ in range(window):
+            acc = C.point_double(f, acc)
+        for j in range(t):
+            idx = digit_vt[:, j]
+            pj = _tree(
+                lambda a: jnp.take_along_axis(
+                    a[:, j],
+                    idx.reshape(idx.shape + (1,) * (a.ndim - 2)),
+                    axis=1,
+                ).squeeze(1),
+                table,
+            )
+            acc = C.point_add(f, acc, pj)
+        return acc, None
+
+    xs = jnp.flip(jnp.moveaxis(digits, -1, 0), axis=0)  # MSB first
+    acc, _ = lax.scan(body, init, xs)
+    return acc
+
+
+def msm(f: FieldOps, fr_ctx: ModCtx, points, scalars, nbits=64, window=8):
+    """Single-segment convenience: sum_i scalars[i] * points[i]."""
+    n = jax.tree_util.tree_leaves(points)[0].shape[0]
+    seg = jnp.zeros((n,), jnp.int32)
+    out = msm_segmented(
+        f, fr_ctx, points, scalars, seg, 1, nbits=nbits, window=window
+    )
+    return _tree(lambda a: a[0], out)
+
+
+_MSM_MODE: bool | None = None
+
+
+def set_msm(mode: bool | None) -> None:
+    """Force the grouped-RLC randomization stage onto (True) / off (False)
+    the Pippenger kernel; None restores the default (env override
+    CHARON_MSM=0, else on)."""
+    global _MSM_MODE
+    _MSM_MODE = mode
+
+
+def msm_active() -> bool:
+    if _MSM_MODE is not None:
+        return _MSM_MODE
+    return os.environ.get("CHARON_MSM") != "0"

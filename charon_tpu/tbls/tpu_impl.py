@@ -64,8 +64,9 @@ class TPUImpl(Implementation):
         self.verify_inputs = verify_inputs
         self._host = PythonImpl()
         # degradation ladder for device failures in the RLC batch path
-        # (mirrors bench.py): fused-fp2 off first, then RLC off entirely
-        self._degrade_rungs = ["fp2-fusion-off"]
+        # (mirrors bench.py): Pippenger MSM off first (the newest kernel
+        # family), then fused-fp2 off, then RLC off entirely
+        self._degrade_rungs = ["msm-off", "fp2-fusion-off"]
 
     # -- host-side secret ops (delegate to the Python backend) ------------
 
@@ -164,7 +165,16 @@ class TPUImpl(Implementation):
                 from charon_tpu.app import log
                 from charon_tpu.ops import fptower
 
+                from charon_tpu.ops import msm as MSM
+
                 rung = self._degrade_rungs.pop(0) if self._degrade_rungs else None
+                if rung == "msm-off" and not MSM.msm_active():
+                    # another impl already burned this rung process-wide
+                    rung = (
+                        self._degrade_rungs.pop(0)
+                        if self._degrade_rungs
+                        else None
+                    )
                 if rung == "fp2-fusion-off" and not fptower._FP2_FUSION:
                     # another impl already burned this rung process-wide;
                     # retrying the identical path would fail identically
@@ -175,13 +185,16 @@ class TPUImpl(Implementation):
                     err=f"{type(e).__name__}: {str(e)[:160]}",
                     rung=rung or "rlc-disabled",
                 )
-                if rung == "fp2-fusion-off":
+                if rung in ("msm-off", "fp2-fusion-off"):
                     from charon_tpu.ops import blsops
 
-                    fptower.set_fp2_fusion(False)
-                    # the flag is read at TRACE time: without dropping the
-                    # cached jit wrappers the retry re-runs the identical
-                    # compiled fused executable
+                    if rung == "msm-off":
+                        MSM.set_msm(False)
+                    else:
+                        fptower.set_fp2_fusion(False)
+                    # the flags are read at TRACE time: without dropping
+                    # the cached jit wrappers the retry re-runs the
+                    # identical compiled executable
                     blsops.clear_kernel_caches()
                     continue
                 self.RLC_MIN_BATCH = 1 << 62  # disables RLC for this impl

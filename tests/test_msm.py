@@ -1,0 +1,162 @@
+"""Pippenger MSM kernel (ops/msm.py) vs the per-lane scalar-mul oracle.
+
+Bit-exact equivalence is required: the MSM path replaces
+point_scalar_mul + tree-sum inside the grouped-RLC verify kernel, so any
+divergence is a soundness bug, not a tolerance question.
+"""
+
+import random
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from charon_tpu.crypto import bls, h2c
+from charon_tpu.ops import curve as C
+from charon_tpu.ops import limb
+from charon_tpu.ops import msm as MSM
+
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = pytest.mark.slow
+
+
+def _g1_points(ctx, n, with_identity=True):
+    pts = []
+    for i in range(n):
+        if with_identity and i == 2:
+            pts.append(None)  # identity lane (padding in production)
+        else:
+            sk = bls.keygen(bytes([i + 1]) * 32)
+            pts.append(bls.sk_to_pk(sk))
+    return C.g1_pack(ctx, pts)
+
+
+def _g2_points(ctx, n):
+    return C.g2_pack(
+        ctx, [h2c.hash_to_g2(b"msm-%d" % i) for i in range(n)]
+    )
+
+
+def _scalars(fr_ctx, n, nbits=64, seed=7, with_zero=True):
+    rng = random.Random(seed)
+    vals = [rng.randrange(1, 1 << nbits) for _ in range(n)]
+    if with_zero and n > 1:
+        vals[1] = 0  # padding lanes carry zero exponents
+    return vals, jnp.asarray(limb.ctx_pack(fr_ctx, vals))
+
+
+def _oracle(f, fr_ctx, proj, scal, seg_ids, n_seg, nbits):
+    """Reference reduction: per-lane double-and-add, then masked sums."""
+    per_lane = C.point_scalar_mul(f, fr_ctx, proj, scal, nbits=nbits)
+    outs = []
+    for s in range(n_seg):
+        mask = jnp.asarray([i == s for i in seg_ids])
+        sel = jax.tree_util.tree_map(
+            lambda a: a, per_lane
+        )
+        sel = C.point_select(
+            f, mask, sel, C.point_identity(f, (len(seg_ids),))
+        )
+        acc = jax.tree_util.tree_map(lambda a: a[0], sel)
+        for i in range(1, len(seg_ids)):
+            acc = C.point_add(
+                f, acc, jax.tree_util.tree_map(lambda a: a[i], sel)
+            )
+        outs.append(acc)
+    stack = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *outs
+    )
+    return stack
+
+
+def _affine_ints(ctx, f, p):
+    aff = C.point_to_affine(f, p)
+    return jax.tree_util.tree_map(
+        lambda a: limb.unpack_mont_host(ctx, a), aff
+    )
+
+
+@pytest.mark.parametrize("window", [4, 8])
+def test_msm_g1_segmented_matches_oracle(window):
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    f = C.g1_ops(ctx)
+    n, n_seg = 7, 3
+    aff = _g1_points(ctx, n)
+    proj = C.affine_to_point(f, aff)
+    _, scal = _scalars(fr_ctx, n)
+    seg_ids = [i % n_seg for i in range(n)]
+    got = jax.jit(
+        lambda p, s: MSM.msm_segmented(
+            f, fr_ctx, p, s, jnp.asarray(seg_ids, jnp.int32), n_seg,
+            nbits=64, window=window,
+        )
+    )(proj, scal)
+    want = _oracle(f, fr_ctx, proj, scal, seg_ids, n_seg, nbits=64)
+    assert _affine_ints(ctx, f, got) == _affine_ints(ctx, f, want)
+
+
+def test_msm_g2_single_segment_matches_oracle():
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    f = C.g2_ops(ctx)
+    n = 5
+    aff = _g2_points(ctx, n)
+    proj = C.affine_to_point(f, aff)
+    _, scal = _scalars(fr_ctx, n)
+    got = jax.jit(
+        lambda p, s: MSM.msm(f, fr_ctx, p, s, nbits=64, window=8)
+    )(proj, scal)
+    want_stack = _oracle(
+        f, fr_ctx, proj, scal, [0] * n, 1, nbits=64
+    )
+    want = jax.tree_util.tree_map(lambda a: a[0], want_stack)
+    assert _affine_ints(ctx, f, got) == _affine_ints(ctx, f, want)
+
+
+def test_msm_all_zero_scalars_is_identity():
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    f = C.g1_ops(ctx)
+    n = 4
+    aff = _g1_points(ctx, n, with_identity=False)
+    proj = C.affine_to_point(f, aff)
+    scal = jnp.asarray(limb.ctx_pack(fr_ctx, [0] * n))
+    got = MSM.msm(f, fr_ctx, proj, scal, nbits=64, window=8)
+    assert bool(C.point_is_identity(f, got))
+
+
+@pytest.mark.parametrize("t", [2, 3])
+def test_windowed_joint_mul_matches_oracle(t):
+    """Straus threshold-recombination shape: (V, t) points with full
+    255-bit scalars, joint mul + sum per validator."""
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    f = C.g2_ops(ctx)
+    v = 2
+    rng = random.Random(31 + t)
+    aff = _g2_points(ctx, v * t)
+    proj_flat = C.affine_to_point(f, aff)
+    proj = jax.tree_util.tree_map(
+        lambda a: a.reshape(v, t, *a.shape[1:]), proj_flat
+    )
+    vals = [rng.randrange(1, 1 << 255) for _ in range(v * t)]
+    scal = jnp.asarray(limb.ctx_pack(fr_ctx, vals)).reshape(v, t, -1)
+    got = jax.jit(
+        lambda p, s: MSM.windowed_joint_mul(f, fr_ctx, p, s, nbits=255)
+    )(proj, scal)
+    # oracle: per-lane 255-bit double-and-add, then per-validator sum
+    per_lane = C.point_scalar_mul(
+        f, fr_ctx, proj, scal.reshape(v, t, -1), nbits=255
+    )
+    want = C.point_sum(f, per_lane, axis=-1)
+    assert _affine_ints(ctx, f, got) == _affine_ints(ctx, f, want)
+
+
+def test_msm_single_lane_matches_scalar_mul():
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    f = C.g1_ops(ctx)
+    aff = _g1_points(ctx, 1, with_identity=False)
+    proj = C.affine_to_point(f, aff)
+    vals, scal = _scalars(fr_ctx, 1, with_zero=False)
+    got = MSM.msm(f, fr_ctx, proj, scal, nbits=64, window=8)
+    want = C.point_scalar_mul(f, fr_ctx, proj, scal, nbits=64)
+    want = jax.tree_util.tree_map(lambda a: a[0], want)
+    assert _affine_ints(ctx, f, got) == _affine_ints(ctx, f, want)

@@ -111,7 +111,10 @@ def test_engine_grouped_pads_m3_to_4():
     the per-lane fallback."""
     from isolation_util import ISOLATED_HEADER, run_isolated
 
-    run_isolated(ISOLATED_HEADER + _PAD_PATH_SCRIPT, "PAD-PATH-OK")
+    # 45 min: the script cold-compiles TWO programs on the 1-core VM —
+    # the padded grouped kernel (now including the Pippenger MSM stage)
+    # and the per-lane attribution kernel for the invalid-batch case
+    run_isolated(ISOLATED_HEADER + _PAD_PATH_SCRIPT, "PAD-PATH-OK", timeout=2700)
 
 
 def test_grouped_zero_exponent_lanes_neutral(kernel):
