@@ -108,26 +108,33 @@ def clear_kernel_caches() -> None:
             fn.cache_clear()
 
 
+def threshold_recombine(ctx: ModCtx, fr_ctx: ModCtx, t: int, sig_affine, idx):
+    """(V, t) affine G2 share sigs + (V, t) int32 share indices -> [V]
+    affine group signatures. THE threshold-recombination routine — the
+    single place that decides Straus joint windowed mul (one shared
+    doubling chain per validator, ops/msm.py) vs per-lane 255-bit
+    double-and-add; both _threshold_agg_kernel and the sharded mesh
+    plane (parallel/mesh.py) call it."""
+    f = C.g2_ops(ctx)
+    coeffs = lagrange_coeffs_at_zero(fr_ctx, idx, t)  # (V, t, L)
+    proj = C.affine_to_point(f, sig_affine)
+    from charon_tpu.ops import msm as MSM
+
+    if MSM.msm_active():
+        total = MSM.windowed_joint_mul(f, fr_ctx, proj, coeffs)
+    else:
+        scaled = C.point_scalar_mul(f, fr_ctx, proj, coeffs)
+        total = C.point_sum(f, scaled, axis=-1)  # reduce the t axis
+    return C.point_to_affine(f, total)
+
+
 @functools.lru_cache(maxsize=None)
 def _threshold_agg_kernel(ctx: ModCtx, fr_ctx: ModCtx, t: int):
-    f = C.g2_ops(ctx)
-
-    def kernel(sig_affine, idx):
-        # sig_affine: affine G2 with batch shape (V, t); idx: (V, t) int32
-        coeffs = lagrange_coeffs_at_zero(fr_ctx, idx, t)  # (V, t, L)
-        proj = C.affine_to_point(f, sig_affine)
-        from charon_tpu.ops import msm as MSM
-
-        if MSM.msm_active():
-            # Straus joint windowed mul: one shared doubling chain per
-            # validator instead of t per-lane 255-bit double-and-adds
-            total = MSM.windowed_joint_mul(f, fr_ctx, proj, coeffs)
-        else:
-            scaled = C.point_scalar_mul(f, fr_ctx, proj, coeffs)
-            total = C.point_sum(f, scaled, axis=-1)  # reduce the t axis
-        return C.point_to_affine(f, total)
-
-    return jax.jit(kernel)
+    return jax.jit(
+        lambda sig_affine, idx: threshold_recombine(
+            ctx, fr_ctx, t, sig_affine, idx
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)

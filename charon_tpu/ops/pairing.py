@@ -501,20 +501,36 @@ def batched_verify_grouped_rlc(
         sig_groups = regroup(sig_r, g2f)  # [M] G2 projective
         s_total = _point_sum_tree(C, g2f, sig_groups, m_groups)
 
+    return grouped_rlc_check(ctx, buckets, msg, s_total)
+
+
+def grouped_rlc_check(ctx: ModCtx, buckets, msgs, s_total):
+    """The grouped-RLC verification equation's shared tail: per-group
+    bucket pairs e(B_m, H_m) ++ ONE aggregate pair e(-G1, S), a single
+    product tree and ONE final exponentiation; True iff the product is
+    1. `buckets`: [M] projective G1 bucket sums; `msgs`: [M] affine G2
+    message points; `s_total`: projective G2 aggregate. Soundness-
+    critical — both batched_verify_grouped_rlc and the sharded mesh
+    plane (parallel/mesh.py) verify through THIS function."""
+    g1f, g2f = C.g1_ops(ctx), C.g2_ops(ctx)
     bucket_aff = C.point_to_affine(g1f, buckets)
     s_aff = C.point_to_affine(g2f, s_total)
 
-    # Miller lanes: M bucket pairs ++ 1 aggregate pair, then one final exp
     def append_lane(a, b):
         return jnp.concatenate((a, b[None, ...]), axis=0)
 
     neg_g = neg_g1_gen(ctx, ())
     pk_lanes = jax.tree_util.tree_map(append_lane, bucket_aff, neg_g)
-    q_lanes = jax.tree_util.tree_map(append_lane, msg, s_aff)
+    q_lanes = jax.tree_util.tree_map(append_lane, msgs, s_aff)
     f_lanes = miller_loop(ctx, [(pk_lanes, q_lanes)])  # [M+1] fp12
     f_tot = _fp12_prod_tree(ctx, f_lanes)
     e = final_exp(ctx, f_tot)
     return T.fp12_is_one(ctx, e)
+
+
+def point_sum_tree(f, pts, n: int, axis: int = 0):
+    """Public log-depth point reduction (pairwise complete adds)."""
+    return _point_sum_tree(C, f, pts, n, axis=axis)
 
 
 def batched_verify_rlc(

@@ -104,12 +104,7 @@ class SlotCryptoPlane:
             # of two, which halves the dominant XLA compile cost and keeps
             # the device busy with one large batch instead of two smaller
             # ones).
-            coeffs = blsops.lagrange_coeffs_at_zero(fr_ctx, indices, t)
-            proj = C.affine_to_point(g2f, partials)
-            scaled = C.point_scalar_mul(g2f, fr_ctx, proj, coeffs)
-            group_sig = C.point_to_affine(
-                g2f, C.point_sum(g2f, scaled, axis=-1)
-            )
+            group_sig = blsops.threshold_recombine(ctx, fr_ctx, t, partials, indices)
 
             # Verify lanes: [Vl, t] per-share partials ++ [Vl, 1] group sig,
             # flattened to one [Vl*(t+1)] batch.
@@ -152,21 +147,8 @@ class SlotCryptoPlane:
         g2f = C.g2_ops(ctx)
 
         def local_step(pubshares, msg, partials, group_pk, indices, live, rand):
-            coeffs = blsops.lagrange_coeffs_at_zero(fr_ctx, indices, t)
-            proj = C.affine_to_point(g2f, partials)
-            scaled = C.point_scalar_mul(g2f, fr_ctx, proj, coeffs)
-            group_sig = C.point_to_affine(
-                g2f, C.point_sum(g2f, scaled, axis=-1)
-            )
+            group_sig = blsops.threshold_recombine(ctx, fr_ctx, t, partials, indices)
 
-            cat = lambda a, b: jnp.concatenate(
-                (a, b[:, None, ...]), axis=1
-            ).reshape(-1, *a.shape[2:])
-            pk_all = jax.tree_util.tree_map(cat, pubshares, group_pk)
-            sig_all = jax.tree_util.tree_map(cat, partials, group_sig)
-            msg_rep = jax.tree_util.tree_map(
-                lambda a: jnp.repeat(a, t + 1, axis=0), msg
-            )
             # INDEPENDENT exponent per verify lane ([Vl, t+1] from the
             # host): sharing one exponent across a validator's t+1 lanes
             # would let colluding operators craft partial-sig deltas whose
@@ -175,12 +157,57 @@ class SlotCryptoPlane:
             # combination of the partial errors). Padding lanes carry
             # live=False: zero their exponent so their (possibly garbage)
             # pairing value contributes ^0 = 1.
-            rand_flat = jnp.where(
-                live[:, None, None], rand, 0
-            ).reshape(-1, rand.shape[-1])
-            ok = DP.batched_verify_rlc(
-                ctx, fr_ctx, pk_all, msg_rep, sig_all, rand_flat
+            rand_live = jnp.where(live[:, None, None], rand, 0)
+            cat_grid = lambda a, b: jnp.concatenate(
+                (a, b[:, None, ...]), axis=1
             )
+            pk_grid = jax.tree_util.tree_map(cat_grid, pubshares, group_pk)
+            sig_grid = jax.tree_util.tree_map(cat_grid, partials, group_sig)
+
+            from charon_tpu.ops import msm as MSM
+
+            if MSM.msm_active():
+                # Grouped RLC: a validator's t+1 lanes share its duty
+                # message, so they collapse into ONE bucket pair
+                # e(sum_j r_vj * pk_vj, H_v) — the Miller stage runs
+                # Vl + 1 pairs instead of Vl * (t+1), a (t+1)x cut in
+                # the dominant stage. Straus joint mul batches the
+                # 64-bit randomization over the (Vl, t+1) grid; per-lane
+                # exponents keep the independence property above (same
+                # construction as pairing.batched_verify_grouped_rlc
+                # with per-validator groups).
+                g1f = C.g1_ops(ctx)
+                buckets = MSM.windowed_joint_mul(
+                    g1f,
+                    fr_ctx,
+                    C.affine_to_point(g1f, pk_grid),
+                    rand_live,
+                    nbits=64,
+                )
+                sig_v = MSM.windowed_joint_mul(
+                    g2f,
+                    fr_ctx,
+                    C.affine_to_point(g2f, sig_grid),
+                    rand_live,
+                    nbits=64,
+                )
+                s_total = DP.point_sum_tree(g2f, sig_v, live.shape[0])
+                ok = DP.grouped_rlc_check(ctx, buckets, msg, s_total)
+            else:
+                flat = lambda a: a.reshape(-1, *a.shape[2:])
+                pk_all = jax.tree_util.tree_map(flat, pk_grid)
+                sig_all = jax.tree_util.tree_map(flat, sig_grid)
+                msg_rep = jax.tree_util.tree_map(
+                    lambda a: jnp.repeat(a, t + 1, axis=0), msg
+                )
+                ok = DP.batched_verify_rlc(
+                    ctx,
+                    fr_ctx,
+                    pk_all,
+                    msg_rep,
+                    sig_all,
+                    rand_live.reshape(-1, rand.shape[-1]),
+                )
             bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
             return group_sig, bad == 0
 

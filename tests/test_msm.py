@@ -52,11 +52,8 @@ def _oracle(f, fr_ctx, proj, scal, seg_ids, n_seg, nbits):
     outs = []
     for s in range(n_seg):
         mask = jnp.asarray([i == s for i in seg_ids])
-        sel = jax.tree_util.tree_map(
-            lambda a: a, per_lane
-        )
         sel = C.point_select(
-            f, mask, sel, C.point_identity(f, (len(seg_ids),))
+            f, mask, per_lane, C.point_identity(f, (len(seg_ids),))
         )
         acc = jax.tree_util.tree_map(lambda a: a[0], sel)
         for i in range(1, len(seg_ids)):
