@@ -125,9 +125,13 @@ async def build_node(config: Config) -> Node:
                 from charon_tpu.core.cryptoplane import SlotCoalescer
                 from charon_tpu.parallel import SlotCryptoPlane, make_mesh
 
+                plane_factory = lambda: SlotCryptoPlane(  # noqa: E731
+                    make_mesh(jax.devices()), t=t
+                )
                 crypto_plane = SlotCoalescer(
-                    SlotCryptoPlane(make_mesh(jax.devices()), t=t),
+                    plane_factory(),
                     window=config.crypto_plane_window,
+                    plane_factory=plane_factory,
                 )
                 log.info(
                     "crypto plane installed",

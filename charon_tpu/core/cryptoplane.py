@@ -91,11 +91,20 @@ class SlotCoalescer:
     (exported as node metrics by app/run.py).
     """
 
-    def __init__(self, plane, window: float = 0.02, metrics_hook=None):
+    def __init__(
+        self, plane, window: float = 0.02, metrics_hook=None, plane_factory=None
+    ):
         import concurrent.futures
 
         self.plane = plane
         self.window = window
+        # msm-off degradation rung (mirrors tbls/tpu_impl._rlc_guarded):
+        # a device/compile failure in the newest kernel family is not a
+        # crypto verdict. plane_factory() rebuilds the plane after the
+        # flag flip so its jitted programs re-trace; without a factory
+        # the rung still flips the flag for any later plane builds.
+        self._plane_factory = plane_factory
+        self._degraded = False
         self._verify_q: list[_VerifyJob] = []
         self._recombine_q: list[_RecombineJob] = []
         self._flush_task: asyncio.Task | None = None
@@ -217,13 +226,16 @@ class SlotCoalescer:
             vres, rres = await loop.run_in_executor(
                 self._executor, self._run_device, vq, rq
             )
-        except Exception as e:  # noqa: BLE001 — fail all waiters, not the loop
-            for job in [*vq, *rq]:
-                if not job.fut.done():
-                    job.fut.set_exception(
-                        TblsError(f"crypto plane flush failed: {e}")
-                    )
-            return
+        except Exception as e:  # noqa: BLE001 — degrade, else fail waiters
+            retried = await self._degrade_and_retry(vq, rq, e)
+            if retried is None:
+                for job in [*vq, *rq]:
+                    if not job.fut.done():
+                        job.fut.set_exception(
+                            TblsError(f"crypto plane flush failed: {e}")
+                        )
+                return
+            vres, rres = retried
         for job, res in zip(vq, vres):
             if not job.fut.done():
                 job.fut.set_result(res)
@@ -231,10 +243,54 @@ class SlotCoalescer:
             if not job.fut.done():
                 job.fut.set_result(res)
 
+    async def _degrade_and_retry(self, vq, rq, err):
+        """One-shot msm-off rung: flip the MSM family off, rebuild the
+        plane so its programs re-trace, and retry the SAME batch on the
+        per-lane path. Returns (vres, rres) or None if the rung is spent
+        / inapplicable / the retry also failed."""
+        from charon_tpu.ops import blsops
+        from charon_tpu.ops import msm as MSM
+
+        if (
+            self._degraded
+            or not MSM.msm_active()
+            or self._plane_factory is None
+        ):
+            # no factory -> no retry: the plane's jitted programs are
+            # per-instance, so without a rebuild the retry would re-run
+            # the identical failed executable
+            return None
+        self._degraded = True
+        from charon_tpu.app import log
+
+        log.warn(
+            "crypto plane flush failed on device; degrading",
+            topic="cryptoplane",
+            rung="msm-off",
+            err=f"{type(err).__name__}: {str(err)[:160]}",
+        )
+        MSM.set_msm(False)
+        blsops.clear_kernel_caches()
+
+        def rebuild_and_run():
+            # worker thread, NOT the event loop: the factory touches
+            # jax.devices()/compilation, which can block for minutes on
+            # a wedged device claim
+            self.plane = self._plane_factory()
+            return self._run_device(vq, rq)
+
+        try:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(self._executor, rebuild_and_run)
+        except Exception:  # noqa: BLE001 — rung spent; caller fails waiters
+            return None
+
     # -- device side (worker thread) --------------------------------------
 
     def _run_device(self, vq: list[_VerifyJob], rq: list[_RecombineJob]):
-        lanes_before = self.lanes_flushed
+        # counters update only AFTER both stages succeed: a failed flush
+        # that the degrade rung retries must not double-count its lanes
+        lanes = 0
         vres: list[list[bool]] = []
         if vq:
             flat: list = []
@@ -252,7 +308,7 @@ class SlotCoalescer:
                         for l in job.lanes
                     ]
                 )
-            self.lanes_flushed += len(flat)
+            lanes += len(flat)
         rres: list[tuple[list, list[bool]]] = []
         if rq:
             ps, msg, sig, gpk, idx = [], [], [], [], []
@@ -282,12 +338,11 @@ class SlotCoalescer:
                         sigs_pts.append(next(it_sig))
                         oks.append(next(it_ok))
                 rres.append((sigs_pts, oks))
-            self.lanes_flushed += len(msg)
+            lanes += len(msg)
+        self.lanes_flushed += lanes
         self.flushes += 1
         if len(vq) + len(rq) >= 2:
             self.coalesced_flushes += 1
         if self.metrics_hook is not None:
-            self.metrics_hook(
-                len(vq) + len(rq), self.lanes_flushed - lanes_before
-            )
+            self.metrics_hook(len(vq) + len(rq), lanes)
         return vres, rres

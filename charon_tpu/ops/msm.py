@@ -152,12 +152,21 @@ def msm_segmented(
     xs = _tree(lambda a: jnp.moveaxis(a, 2, 0), buckets)
     (_, windows), _ = lax.scan(wstep, init, xs)  # [n_win, n_segments]
 
-    # Horner across windows, most significant first: acc = 2^w acc + W
+    # Horner across windows, most significant first: acc = 2^w acc + W.
+    # A lax.scan (not a Python unroll) keeps the compiled graph at ONE
+    # w-double+add body regardless of window count — the unrolled form
+    # added ~n_win*(w+1) point-op subgraphs to every MSM program and
+    # dominated XLA compile time on the grouped kernels.
     acc = _tree(lambda a: a[n_win - 1], windows)
-    for win in range(n_win - 2, -1, -1):
+
+    def horner_step(carry, w_point):
         for _ in range(window):
-            acc = C.point_double(f, acc)
-        acc = C.point_add(f, acc, _tree(lambda a: a[win], windows))
+            carry = C.point_double(f, carry)
+        return C.point_add(f, carry, w_point), None
+
+    if n_win > 1:
+        xs = _tree(lambda a: jnp.flip(a[: n_win - 1], axis=0), windows)
+        acc, _ = lax.scan(horner_step, acc, xs)
     return acc
 
 

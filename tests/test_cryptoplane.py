@@ -171,6 +171,69 @@ def test_verify_lanes_coalesce_across_components():
     assert plane.coalesced_flushes == 1
 
 
+def test_flush_failure_degrades_msm_and_retries():
+    """A device failure during a flush is not a crypto verdict: the
+    coalescer flips the MSM family off, rebuilds the plane via the
+    factory, and retries the SAME batch — waiters get results, not
+    errors (the msm-off rung, mirroring tbls/tpu_impl._rlc_guarded)."""
+    from charon_tpu.ops import msm as MSM
+
+    impl = PythonImpl()
+
+    class BoomPlane(FakePlane):
+        def verify_host(self, pks, msgs, sigs, rng=None):
+            raise RuntimeError("MOSAIC lowering failed")
+
+    good = FakePlane(T)
+    plane = SlotCoalescer(
+        BoomPlane(T), window=0.01, plane_factory=lambda: good
+    )
+
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    root = b"\x55" * 32
+    sig = impl.sign(sk, root)
+
+    try:
+        assert MSM.msm_active()
+        res = asyncio.run(plane.verify([(pk, root, sig)]))
+        assert res == [True]
+        assert good.verify_calls == 1, "retry must run on the rebuilt plane"
+        assert MSM.msm_active() is False, "rung must flip the family off"
+        assert plane.plane is good
+    finally:
+        MSM.set_msm(None)
+
+
+def test_flush_failure_after_spent_rung_fails_waiters():
+    """Once the rung is spent (or the family already off), a flush
+    failure surfaces to waiters as TblsError instead of looping."""
+    from charon_tpu import tbls as tbls_mod
+    from charon_tpu.ops import msm as MSM
+
+    impl = PythonImpl()
+
+    class BoomPlane(FakePlane):
+        def verify_host(self, pks, msgs, sigs, rng=None):
+            raise RuntimeError("still broken")
+
+    plane = SlotCoalescer(
+        BoomPlane(T), window=0.01, plane_factory=lambda: BoomPlane(T)
+    )
+
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    root = b"\x66" * 32
+    sig = impl.sign(sk, root)
+
+    try:
+        with pytest.raises(tbls_mod.TblsError, match="flush failed"):
+            asyncio.run(plane.verify([(pk, root, sig)]))
+        assert MSM.msm_active() is False
+    finally:
+        MSM.set_msm(None)
+
+
 def test_recombine_decode_failure_isolated():
     """A duty carrying an undecodable partial fails alone; a concurrent
     healthy duty still aggregates in the same flush."""
