@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 watcher: claim-gate each measurement, run the queue in value
+# order (VERDICT r5: one live window must measure the round-4 kernels —
+# MSM on/off attribution, slot-step, MXU A/B, DKG — before anything new
+# is built). ADVICE r4 fixes: paths parameterized, per-entry attempts
+# BOUNDED (a permanently wedged claim skips the entry instead of
+# blocking the queue forever), and nonzero bench rc is recorded.
+REPO="${REPO:-$(cd "$(dirname "$0")" && pwd)}"
+log="$REPO/bench_r5_auto.log"
+out="$REPO/bench_r5_auto.out"
+MAX_ATTEMPTS="${MAX_ATTEMPTS:-20}"   # x (900s probe + 60s sleep) ~ 5h/entry
+cd "$REPO" || exit 1
+
+run_gated() {
+  name="$1"; shift
+  attempt=0
+  while [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
+    attempt=$((attempt+1))
+    echo "[watch5 $(date +%H:%M:%S)] $name: claim attempt $attempt/$MAX_ATTEMPTS (timeout 900s)" >> "$log"
+    if timeout 900 python "$REPO/.claim_probe.py" >> "$REPO/.claim_probe.log" 2>&1; then
+      echo "[watch5 $(date +%H:%M:%S)] $name: claim ok, running" >> "$log"
+      "$@" >> "$out" 2>> "$log"
+      rc=$?
+      echo "[watch5 $(date +%H:%M:%S)] $name exited rc=$rc" >> "$log"
+      return $rc
+    fi
+    echo "[watch5 $(date +%H:%M:%S)] $name: claim failed/hung, retry in 60s" >> "$log"
+    sleep 60
+  done
+  echo "[watch5 $(date +%H:%M:%S)] $name: SKIPPED after $MAX_ATTEMPTS claim attempts" >> "$log"
+  return 124
+}
+
+# Value order. bench.py itself sweeps 256->1024->4096 ascending and banks
+# the best, so even one short window yields a driver-format TPU line.
+run_gated headline python bench.py
+run_gated breakdown python bench_breakdown.py
+run_gated msm_off env CHARON_MSM=0 BENCH_BATCHES=4096 python bench.py
+run_gated slotstep python bench_slotstep.py
+run_gated mxu_ab env BENCH_MXU=1 BENCH_BATCHES=4096 python bench.py
+run_gated fp2_wide env BENCH_BATCHES="16384 8192" python bench.py
+run_gated dkg python bench_dkg.py
+echo "[watch5 $(date +%H:%M:%S)] full suite done" >> "$log"

@@ -29,11 +29,20 @@ CONFIG level, overriding the env var.
 
 from __future__ import annotations
 
+import os
+
 RELAY_PROBE_PORT = 8083
 
-# Total backend-claim attempts (each bounded by the watchdog timeout)
-# before the re-exec pins to CPU: ~15 min of patience for a transient
-# post-disconnect wedge, still far inside the driver's bench budget.
+# Total wall-clock budget for TPU claim attempts before the re-exec pins
+# to CPU (VERDICT r4 next-step 2: retry the claim for the FULL bench
+# budget, not a fixed 3 attempts — the r4 wedge cleared after ~16 min
+# while the old 3x300s ladder had already pinned to CPU). The deadline
+# is carried across re-execs in CHARON_BENCH_CLAIM_DEADLINE (epoch
+# seconds) so the window is global, not per-attempt.
+CLAIM_BUDGET_S = float(os.environ.get("CHARON_BENCH_CLAIM_BUDGET", 2400))
+
+# kept for the supervisor tests' ladder accounting: attempts are now
+# unbounded within the budget window
 CLAIM_ATTEMPTS = 3
 
 
@@ -52,11 +61,26 @@ def tunnel_alive(timeout: float = 3.0) -> bool:
         s.close()
 
 
-def claim_retry_env(attempt: int) -> dict[str, str]:
+def claim_retry_env(attempt: int, now: float | None = None) -> dict[str, str]:
     """Env updates for the re-exec after a wedged TPU claim: fresh TPU
-    attempts until CLAIM_ATTEMPTS is exhausted, then the CPU pin."""
-    if attempt < CLAIM_ATTEMPTS:
-        return {"CHARON_BENCH_CLAIM_ATTEMPT": str(attempt + 1)}
+    attempts until the global claim deadline (first wedge + CLAIM_BUDGET_S,
+    carried across re-execs) passes, then the CPU pin."""
+    import time
+
+    now = time.time() if now is None else now
+    try:
+        deadline = float(os.environ.get("CHARON_BENCH_CLAIM_DEADLINE", "0"))
+    except ValueError:
+        # malformed env must not kill the watchdog thread (the process
+        # would hang with no JSON line at all) — re-anchor instead
+        deadline = 0.0
+    if not deadline:
+        deadline = now + CLAIM_BUDGET_S
+    if now < deadline:
+        return {
+            "CHARON_BENCH_CLAIM_ATTEMPT": str(attempt + 1),
+            "CHARON_BENCH_CLAIM_DEADLINE": repr(deadline),
+        }
     return {"CHARON_BENCH_FORCE_CPU": "1", "CHARON_BENCH_TUNNEL": "wedged"}
 
 
@@ -89,6 +113,19 @@ def init_jax_with_watchdog(metric: str, unit: str, timeout: float = 300.0):
         os.environ["CHARON_BENCH_TUNNEL"] = "down"
         force_cpu = True
 
+    if force_cpu and "--xla_backend_optimization_level" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        # The CPU fallback is a liveness/honesty datapoint, not a perf
+        # claim (its JSON line says so) — compile it at opt 0 like the
+        # dryrun/conftest so the driver's fallback path takes minutes,
+        # not the tens of minutes a full-opt XLA:CPU pairing compile
+        # costs on a 1-core host.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_backend_optimization_level=0"
+        ).strip()
+
     init_done = threading.Event()
 
     def _watchdog():
@@ -112,12 +149,12 @@ def init_jax_with_watchdog(metric: str, unit: str, timeout: float = 300.0):
             stage = (
                 "re-exec for a fresh TPU claim"
                 if "CHARON_BENCH_CLAIM_ATTEMPT" in updates
-                else "re-exec pinned to CPU"
+                else "re-exec pinned to CPU (claim budget exhausted)"
             )
             print(
                 f"[bench_common] backend claim hung >{int(timeout)}s with "
-                f"tunnel port open (attempt {attempt}/{CLAIM_ATTEMPTS}): "
-                f"{stage}",
+                f"tunnel port open (attempt {attempt}, budget "
+                f"{int(CLAIM_BUDGET_S)}s): {stage}",
                 file=sys.stderr,
                 flush=True,
             )
@@ -149,8 +186,12 @@ def init_jax_with_watchdog(metric: str, unit: str, timeout: float = 300.0):
 
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # TPU runs share one cache (remote-compiled device programs are
+    # host-portable); CPU fallbacks use a host-fingerprinted dir because
+    # XLA:CPU AOT entries from another machine fail to load.
+    from charon_tpu import jaxcache
+
+    jaxcache.configure(jax, cpu=force_cpu)
     jax.devices()  # force the backend claim while the watchdog is armed
     init_done.set()
     return jax

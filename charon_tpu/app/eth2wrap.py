@@ -211,43 +211,9 @@ class LazyClient:
         return call
 
 
-class ExpBackoff:
-    """Exponential backoff with full jitter and reset
-    (ref: app/expbackoff/expbackoff.go — used by the relay reserver and
-    DKG sync clients)."""
-
-    def __init__(
-        self,
-        base: float = 0.25,
-        factor: float = 2.0,
-        max_delay: float = 30.0,
-        jitter: bool = True,
-    ) -> None:
-        self.base = base
-        self.factor = factor
-        self.max_delay = max_delay
-        self.jitter = jitter
-        self._attempt = 0
-        self._waited = False
-
-    def next_delay(self) -> float:
-        import random
-
-        delay = min(self.max_delay, self.base * self.factor**self._attempt)
-        self._attempt += 1
-        return random.uniform(0, delay) if self.jitter else delay
-
-    async def wait(self) -> None:
-        # first call returns immediately WITHOUT consuming an attempt, so
-        # the first real sleep is the base delay (not base*factor)
-        if self._waited:
-            await asyncio.sleep(self.next_delay())
-        else:
-            self._waited = True
-
-    def reset(self) -> None:
-        self._attempt = 0
-        self._waited = False
+# Canonical home is the dedicated util module (ref:
+# app/expbackoff/expbackoff.go); re-exported here for existing importers.
+from charon_tpu.app.expbackoff import ExpBackoff  # noqa: E402
 
 
 SYNTH_GRAFFITI = b"charon-tpu-synthetic"

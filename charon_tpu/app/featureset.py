@@ -20,6 +20,10 @@ class Feature(str, enum.Enum):
     # Current framework features (the reference's set evolves per release;
     # these are ours).
     AGG_SIG_DB_V2 = "agg_sigdb_v2"
+    # Eager-double-linear consensus round timer (ref:
+    # app/featureset/featureset.go:32 EagerDoubleLinear; timer semantics
+    # in core/qbft.py DoubleEagerLinearRoundTimer).
+    EAGER_DOUBLE_LINEAR = "eager_double_linear"
     QBFT_CONSENSUS = "qbft_consensus"
     TPU_BATCH_VERIFY = "tpu_batch_verify"
     JSON_REQUESTS = "json_requests"
@@ -28,6 +32,8 @@ class Feature(str, enum.Enum):
 
 _STATUSES: dict[Feature, Status] = {
     Feature.AGG_SIG_DB_V2: Status.ALPHA,
+    # stable = cluster default, matching ref featureset.go:53
+    Feature.EAGER_DOUBLE_LINEAR: Status.STABLE,
     Feature.QBFT_CONSENSUS: Status.STABLE,
     Feature.TPU_BATCH_VERIFY: Status.STABLE,
     Feature.JSON_REQUESTS: Status.BETA,

@@ -25,7 +25,7 @@ from charon_tpu.app.eth2wrap import (
 from charon_tpu.app.lifecycle import LifecycleManager, Order
 from charon_tpu.app.metrics import ClusterMetrics, instrument, serve_monitoring
 from charon_tpu.cluster.lock import ClusterLock
-from charon_tpu.core.aggsigdb import AggSigDB
+from charon_tpu.core.aggsigdb import new_agg_sigdb
 from charon_tpu.core.bcast import Broadcaster
 from charon_tpu.core.consensus import ConsensusController
 from charon_tpu.core.consensus_qbft import QBFTConsensus
@@ -325,7 +325,9 @@ async def build_node(config: Config) -> Node:
         plane=crypto_plane,
         pubshares_by_idx=pubshares_by_idx if crypto_plane else None,
     )
-    aggsigdb = AggSigDB()
+    # impl selected by the AGG_SIG_DB_V2 feature flag (ref: app wiring
+    # gates memory_v2 behind the alpha flag)
+    aggsigdb = new_agg_sigdb()
     bcast = Broadcaster(beacon=beacon, clock=clock)
     # lock-file registrations re-broadcast every epoch by the recaster
     # (ref: app/app.go:676-743 wireRecaster pre-generate path)
@@ -454,9 +456,16 @@ async def build_node(config: Config) -> Node:
     # slots (ref: core/tracker/inclusion.go, wiring app/app.go:746-780)
     inclusion = None
     if hasattr(beacon, "block_attestations"):
-        inclusion = InclusionChecker(beacon, on_report=_log_inclusion)
+        inclusion = InclusionChecker(
+            beacon, on_report=_log_inclusion, clock=clock
+        )
         bcast.subscribe(inclusion.submitted)
         scheduler.subscribe_slots(inclusion.on_slot)
+        # feed results back into the tracker's chain-inclusion step
+        # counters (ref: app/app.go:562 wires track.InclusionChecked)
+        inclusion.subscribe(
+            lambda r: tracker.inclusion_checked(r.duty, r.pubkey, r.included)
+        )
 
     # in-process validator client for simnet runs (ref: app/vmock.go —
     # the reference wires validatormock when --simnet-validator-mock)

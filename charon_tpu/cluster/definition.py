@@ -15,7 +15,17 @@ from dataclasses import asdict, dataclass, field, replace
 
 from charon_tpu.app import k1util
 
-DEFINITION_VERSION = "ctpu/v1.0"
+# Current definition format revision. v1.1 adds `consensus_protocol`
+# (the cluster's preferred consensus protocol, seeding the runtime
+# priority negotiation) to the signed config payload.
+DEFINITION_VERSION = "ctpu/v1.1"
+
+# Parse/DKG gate: documents in any of these revisions are accepted;
+# anything else is rejected up-front with an actionable error
+# (ref: dkg/dkg.go:108-116 gates supported cluster-definition versions,
+# cluster/definition.go supportedVersions).
+SUPPORTED_VERSIONS = ("ctpu/v1.0", "ctpu/v1.1")
+
 _CONFIG_DOMAIN = b"charon-tpu/definition-config-hash"
 
 
@@ -50,14 +60,19 @@ class ClusterDefinition:
     withdrawal_address: str = ""
     dkg_algorithm: str = "frost"
     creator_address: str = ""
+    # v1.1+: preferred consensus protocol (empty = node default); feeds
+    # the priority/infosync negotiation's proposal ordering
+    consensus_protocol: str = ""
 
     # -- hashing ----------------------------------------------------------
 
     def config_payload(self) -> dict:
         """The operator-agnostic config (what everyone signs) —
         ref: definition.go config hash covers all fields except
-        signatures."""
-        return {
+        signatures. VERSIONED: fields added in later revisions enter the
+        payload only for documents of those revisions, so hashes of old
+        documents stay stable (ref: definition.go hashes per-version)."""
+        out = {
             "name": self.name,
             "uuid": self.uuid,
             "version": self.version,
@@ -74,6 +89,9 @@ class ClusterDefinition:
                 for op in self.operators
             ],
         }
+        if self.version != "ctpu/v1.0":
+            out["consensus_protocol"] = self.consensus_protocol
+        return out
 
     def config_hash(self) -> bytes:
         return hashlib.sha256(
@@ -170,6 +188,14 @@ class ClusterDefinition:
 
     @classmethod
     def from_json(cls, data: dict) -> "ClusterDefinition":
+        version = data.get("version", DEFINITION_VERSION)
+        if version not in SUPPORTED_VERSIONS:
+            # the gate every loader (run, dkg, CLI) passes through
+            # (ref: dkg/dkg.go:108-116)
+            raise ValueError(
+                f"unsupported cluster definition version {version!r}; "
+                f"supported: {', '.join(SUPPORTED_VERSIONS)}"
+            )
         ops = tuple(
             Operator(
                 address=o["address"],
@@ -186,12 +212,20 @@ class ClusterDefinition:
             fork_version=data["fork_version"],
             operators=ops,
             uuid=data["uuid"],
-            version=data.get("version", DEFINITION_VERSION),
+            version=version,
             timestamp=data.get("timestamp", ""),
             fee_recipient_address=data.get("fee_recipient_address", ""),
             withdrawal_address=data.get("withdrawal_address", ""),
             dkg_algorithm=data.get("dkg_algorithm", "frost"),
             creator_address=data.get("creator_address", ""),
+            # v1.0 documents exclude this field from the signed config
+            # hash, so a value smuggled into a signed v1.0 JSON would be
+            # UNAUTHENTICATED — ignore it rather than store it
+            consensus_protocol=(
+                data.get("consensus_protocol", "")
+                if version != "ctpu/v1.0"
+                else ""
+            ),
         )
         if "config_hash" in data:
             want = bytes.fromhex(data["config_hash"][2:])

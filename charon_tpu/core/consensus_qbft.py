@@ -60,6 +60,8 @@ class QBFTConsensus:
         privkey=None,
         pubkeys: list[bytes] | None = None,
         gater=None,
+        timer: str | None = None,
+        linear_round_inc: float = qbft.LINEAR_ROUND_INC,
     ) -> None:
         """`privkey`/`pubkeys` enable per-message k1 authentication
         (ref: core/consensus/qbft/transport.go:25-50 signs every msg,
@@ -67,7 +69,15 @@ class QBFTConsensus:
         provided, every outbound message is signed over qbft.msg_digest and
         every inbound message — and each of its justification messages — is
         verified against the per-index cluster pubkeys before the engine
-        counts it."""
+        counts it.
+
+        `timer` selects the round-timer strategy: "inc" (increasing,
+        configured by round_timeout/round_increase) or "eager_dlinear"
+        (double-eager-linear, configured by linear_round_inc). None picks
+        per the EAGER_DOUBLE_LINEAR feature flag, mirroring
+        ref: core/consensus/utils/roundtimer.go:26-37 GetTimerFunc +
+        app/featureset/featureset.go:53 (stable → dlinear is the
+        cluster default)."""
         self.net = net
         self.node_idx = net.attach(self)
         self._privkey = privkey
@@ -101,12 +111,32 @@ class QBFTConsensus:
                 return True
             return self._verify_msg(m, check_justification=True)
 
+        if timer is None:
+            from charon_tpu.app import featureset
+
+            timer = (
+                "eager_dlinear"
+                if featureset.enabled(featureset.Feature.EAGER_DOUBLE_LINEAR)
+                else "inc"
+            )
+        if timer == "eager_dlinear":
+            new_timer = lambda: qbft.DoubleEagerLinearRoundTimer(  # noqa: E731
+                linear_round_inc
+            )
+        elif timer == "inc":
+            new_timer = lambda: qbft.IncreasingRoundTimer(  # noqa: E731
+                round_timeout, round_increase
+            )
+        else:
+            raise ValueError(f"unknown round timer strategy: {timer}")
+        self.timer_type = timer
+
         self.defn = qbft.Definition(
             nodes=nodes,
             leader=leader,
-            # ref-equivalent increasing round timer
-            # (core/consensus/utils/roundtimer.go:17-19)
-            timeout=lambda r: round_timeout + round_increase * r,
+            # per-instance round timer, strategy selected above
+            # (ref: core/consensus/utils/roundtimer.go:26-37)
+            new_timer=new_timer,
             is_valid=is_valid,
             sign_msg=sign_msg,
         )

@@ -102,7 +102,8 @@ class SlotCoalescer:
         # a device/compile failure in the newest kernel family is not a
         # crypto verdict. plane_factory() rebuilds the plane after the
         # flag flip so its jitted programs re-trace; without a factory
-        # the rung still flips the flag for any later plane builds.
+        # there is no degrade at all (the flag stays untouched — a retry
+        # without a rebuild would re-run the identical failed executable).
         self._plane_factory = plane_factory
         self._degraded = False
         self._verify_q: list[_VerifyJob] = []
@@ -251,6 +252,17 @@ class SlotCoalescer:
         from charon_tpu.ops import blsops
         from charon_tpu.ops import msm as MSM
 
+        if isinstance(
+            err,
+            (TypeError, ValueError, KeyError, IndexError,
+             AttributeError, AssertionError, TblsError),
+        ):
+            # host-side bug classes (shape/tracing/logic errors): the
+            # per-lane path would hit the same bug, and permanently
+            # disabling the process-wide MSM fast path + paying a
+            # minutes-long plane rebuild on the duty path buys nothing
+            # (ADVICE r4: gate the rung on device/compile error types)
+            return None
         if (
             self._degraded
             or not MSM.msm_active()

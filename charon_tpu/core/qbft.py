@@ -86,6 +86,70 @@ def msg_digest(msg: Msg) -> bytes:
     return hashlib.sha256(material).digest()
 
 
+# Round-timer strategies (ref: core/consensus/utils/roundtimer.go:17-19
+# constants, :72-97 increasing, :99-152 eager-double-linear). A timer is
+# instantiated PER INSTANCE (ref TimerFunc is per duty) because the
+# double-eager variant is stateful across restarts within one instance.
+INC_ROUND_START = 0.75
+INC_ROUND_INCREASE = 0.25
+LINEAR_ROUND_INC = 1.0
+
+
+class IncreasingRoundTimer:
+    """Fresh `start + inc*round` countdown on every (re)arm — a restart
+    for the same round fully resets it."""
+
+    type = "inc"
+
+    def __init__(
+        self,
+        start: float = INC_ROUND_START,
+        increase: float = INC_ROUND_INCREASE,
+    ) -> None:
+        self._start = start
+        self._increase = increase
+
+    def duration(self, rnd: int, now: float) -> float:
+        return self._start + self._increase * rnd
+
+
+class DoubleEagerLinearRoundTimer:
+    """Linear `round * inc` timeout whose per-round deadline is ABSOLUTE:
+    re-arming the same round (the justified-pre-prepare restart) extends
+    the deadline to first_deadline + linear(round) — i.e. doubles the
+    round instead of resetting it, keeping every peer's round end-time
+    aligned with the round start rather than with when each peer happened
+    to see the leader's pre-prepare
+    (ref: core/consensus/utils/roundtimer.go:112-131 rationale)."""
+
+    type = "eager_dlinear"
+
+    def __init__(self, inc: float = LINEAR_ROUND_INC) -> None:
+        self._inc = inc
+        self._first: dict[int, float] = {}
+
+    def duration(self, rnd: int, now: float) -> float:
+        first = self._first.get(rnd)
+        if first is None:
+            deadline = now + self._inc * rnd
+            self._first[rnd] = deadline
+        else:
+            deadline = first + self._inc * rnd
+        return max(0.0, deadline - now)
+
+
+class _FnTimer:
+    """Adapter for the legacy `Definition.timeout` callable."""
+
+    type = "inc"
+
+    def __init__(self, fn: Callable[[int], float]) -> None:
+        self._fn = fn
+
+    def duration(self, rnd: int, now: float) -> float:
+        return self._fn(rnd)
+
+
 @dataclass
 class Definition:
     """Parameters binding the pure engine to an environment."""
@@ -94,6 +158,9 @@ class Definition:
     leader: Callable[[Hashable, int], int]  # (instance, round) -> node idx
     # round -> timeout seconds (ref-equivalent default: 0.75 + 0.25*round)
     timeout: Callable[[int], float] = lambda r: 0.75 + 0.25 * r
+    # Per-instance round-timer factory; when set it takes precedence over
+    # `timeout` (ref: qbft.go:36 Definition.NewTimer from TimerFunc).
+    new_timer: Callable[[], object] | None = None
     # Authenticates a message (signature over msg_digest against the
     # per-index cluster key) AND, for messages carrying justifications,
     # each piggybacked message (ref: qbft.go:561 verifies wrapped msgs).
@@ -179,6 +246,8 @@ class _Engine:
         self.sent_preprepare: set[int] = set()
         self.sent_round_change: set[int] = set()
         self.decided: asyncio.Future = None  # type: ignore
+        self._restart_timer = None  # bound in run()
+        self._timer_round = 0  # round the live timer is armed for
 
     # -- helpers ----------------------------------------------------------
 
@@ -289,16 +358,27 @@ class _Engine:
         self.decided = loop.create_future()
         self.input_value = value
         timer_task: asyncio.Task | None = None
+        rt = (
+            self.d.new_timer()
+            if self.d.new_timer is not None
+            else _FnTimer(self.d.timeout)
+        )
 
-        async def round_timer(rnd: int):
-            await asyncio.sleep(self.d.timeout(rnd))
+        async def round_timer(rnd: int, duration: float):
+            await asyncio.sleep(duration)
             await self._on_timeout(rnd)
 
         def restart_timer():
             nonlocal timer_task
             if timer_task is not None:
                 timer_task.cancel()
-            timer_task = asyncio.create_task(round_timer(self.round))
+            self._timer_round = self.round
+            # duration computed NOW, not when the task first runs: the
+            # eager-dlinear timer must anchor a round's first deadline to
+            # the moment the round starts (its whole point is aligning
+            # deadlines with round starts, not with scheduler latency)
+            d = rt.duration(self.round, loop.time())
+            timer_task = asyncio.create_task(round_timer(self.round, d))
 
         self._restart_timer = restart_timer
         restart_timer()
@@ -328,10 +408,13 @@ class _Engine:
                     break
                 msg = get.result()
                 self.t._consumed(msg)
-                prev_round = self.round
                 if self._accept(msg):
                     await self._on_msg(msg)
-                if self.round != prev_round:
+                # Re-arm only if _on_msg didn't already arm this round
+                # (the justified-pre-prepare rule restarts inline, ref
+                # qbft.go:318-319 — re-arming again here would double the
+                # eager-dlinear deadline twice for one rule firing).
+                if self.round != self._timer_round:
                     restart_timer()
                     # Messages for the new round may already be buffered in
                     # self.msgs (they arrived while we were behind); re-run
@@ -413,6 +496,14 @@ class _Engine:
                 self.round = msg.round
             if self.round not in self.sent_prepare:
                 self.sent_prepare.add(self.round)
+                # Justified pre-prepare restarts the round timer (ref:
+                # qbft.go:318-319). Once per round (the sent_prepare
+                # guard is the ref's isDuplicatedRule): with the
+                # increasing timer this is a full reset; with the
+                # eager-double-linear timer it extends the round to
+                # double its first deadline instead.
+                if self._restart_timer is not None:
+                    self._restart_timer()
                 await self._send(
                     Msg(
                         MsgType.PREPARE,

@@ -40,6 +40,9 @@ class Step(enum.IntEnum):
     SIG_AGG = 8
     AGG_SIG_DB = 9
     BCAST = 10
+    # post-broadcast on-chain verification, fed by the InclusionChecker
+    # (ref: tracker.go chainInclusion step + InclusionChecked input)
+    CHAIN_INCLUSION = 11
 
     def __str__(self) -> str:
         return self.name.lower()
@@ -80,6 +83,7 @@ class Reason(str, enum.Enum):
     PARSIG_INCONSISTENT_SYNC = "par_sig_db_inconsistent_sync"
     AGGREGATION_FAILED = "bug_sig_agg"
     BROADCAST_FAILED = "broadcast_bn_error"
+    NOT_INCLUDED = "not_included_onchain"
     UNKNOWN = "unknown"
 
     def describe(self) -> str:
@@ -101,6 +105,7 @@ _REASON_TEXT = {
     Reason.PARSIG_INCONSISTENT_SYNC: "known limitation: inconsistent sync committee signatures received",
     Reason.AGGREGATION_FAILED: "threshold aggregation or verification failed",
     Reason.BROADCAST_FAILED: "failed to broadcast to the beacon node",
+    Reason.NOT_INCLUDED: "broadcast duty was never included on-chain",
     Reason.UNKNOWN: "unexpected failure",
 }
 
@@ -117,6 +122,7 @@ _FAIL_REASONS = {
     Step.SIG_AGG: Reason.AGGREGATION_FAILED,
     Step.AGG_SIG_DB: Reason.AGGREGATION_FAILED,
     Step.BCAST: Reason.BROADCAST_FAILED,
+    Step.CHAIN_INCLUSION: Reason.NOT_INCLUDED,
 }
 
 # Duty types whose partial signatures legitimately disagree across peers
@@ -228,6 +234,8 @@ class Tracker:
         self.participation_total: dict[int, int] = defaultdict(int)
         self.inconsistent_total: dict[DutyType, int] = defaultdict(int)
         self.unexpected_total: dict[int, int] = defaultdict(int)
+        self.inclusion_included_total: dict[DutyType, int] = defaultdict(int)
+        self.inclusion_missed_total: dict[DutyType, int] = defaultdict(int)
 
     def subscribe(self, sub: ReportSub) -> None:
         self._subs.append(sub)
@@ -249,6 +257,23 @@ class Tracker:
         self, duty: Duty, share_idx: int, pubkey=None, root: bytes | None = None
     ) -> None:
         self._parsigs[duty][pubkey][root or b""].add(share_idx)
+
+    def inclusion_checked(self, duty: Duty, pubkey, included: bool) -> None:
+        """Post-broadcast on-chain result from the InclusionChecker.
+
+        Arrives up to INCL_MISSED_LAG slots after the duty — long past its
+        deadline analysis — so it feeds the standalone chain-inclusion
+        counters rather than the per-duty report (ref: tracker.go:815
+        InclusionChecked feeds a chainInclusion step event).
+        """
+        if included:
+            self.inclusion_included_total[duty.type] += 1
+        else:
+            self.inclusion_missed_total[duty.type] += 1
+            # same (type, step) key shape as every other failed_total
+            # write — consumers unpack 2-tuples (app/run.py health
+            # sampler); the reason is implied by the step
+            self.failed_total[(duty.type, Step.CHAIN_INCLUSION)] += 1
 
     # -- analysis at duty expiry (ref: tracker.go:147-163) ----------------
 

@@ -1,0 +1,66 @@
+"""Persistent XLA compile-cache placement.
+
+XLA:CPU serializes ahead-of-time executables that embed the *compile*
+machine's CPU feature list; loading one on a host with a different
+feature set fails ("machine features don't match ... could SIGILL",
+cpu_aot_loader.cc) and forces a full recompile. That is how the round-4
+multichip dryrun timed out: a 578 MB cache primed on the TPU-window
+host was useless on the driver's host, so the dryrun drowned in loader
+errors while recompiling everything inside its timeout
+(MULTICHIP_r04.json tail).
+
+Placement rule:
+
+* CPU-platform runs key their cache dir by a host fingerprint (hash of
+  the /proc/cpuinfo flags line) — entries compiled on another machine
+  are simply *invisible* instead of noisily rejected, and same-host
+  re-runs still hit warm.
+* TPU-platform runs share one dir: the axon remote-compile service
+  serializes device programs, not host AOT code, so those entries are
+  host-portable and expensive to lose (~4-6 min remote compile per
+  pairing program).
+
+Shared by tests/conftest.py, bench_common.py and __graft_entry__.py so
+every CPU-pinned harness on one host hits the same entries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SHARED = os.path.join(_ROOT, ".jax_cache")
+
+
+def host_fingerprint() -> str:
+    """Stable id for this host's CPU feature set (what the XLA:CPU AOT
+    loader actually checks)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    # sort: flag ORDER is not guaranteed stable across
+                    # kernel versions, the feature SET is what matters
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(flags.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    return "unknown-host"
+
+
+def cache_dir(cpu: bool) -> str:
+    """Cache dir for the given effective platform (see module doc)."""
+    if cpu:
+        return os.path.join(_SHARED, "cpu-" + host_fingerprint())
+    return _SHARED
+
+
+def configure(jax_mod, *, cpu: bool) -> str:
+    """Point jax's persistent compilation cache at the right dir.
+
+    Must run before any compilation; safe before backend init."""
+    d = cache_dir(cpu)
+    jax_mod.config.update("jax_compilation_cache_dir", d)
+    jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return d

@@ -226,10 +226,17 @@ class BeaconMock:
     async def block_attestations(self, slot: int):
         """Attestations included in the block at `slot` (every slot has a
         block in the mock chain). Pool attestations submitted before this
-        call land in the first block materialized afterwards."""
+        call land in the first block materialized afterwards — but only
+        a block AFTER the attestation's slot, as on a real chain (an
+        attestation can never appear in an earlier block)."""
         if slot not in self._blocks:
-            self._blocks[slot] = list(self._att_pool)
-            self._att_pool.clear()
+            take = [
+                a
+                for a in self._att_pool
+                if getattr(a.data, "slot", slot - 1) < slot
+            ]
+            self._blocks[slot] = take
+            self._att_pool = [a for a in self._att_pool if a not in take]
         return self._blocks[slot]
 
     async def block_root(self, slot: int):

@@ -170,7 +170,7 @@ def _build_node(
         from charon_tpu.core.consensus_qbft import QBFTConsensus
 
         consensus = ConsensusController(
-            QBFTConsensus(qbft_net, cluster.n, round_timeout=0.3)
+            QBFTConsensus(qbft_net, cluster.n, round_timeout=0.3, timer="inc")
         )
         # echo stays registered as a switchable alternate so priority
         # negotiation can change the protocol mid-run

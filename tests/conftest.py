@@ -33,6 +33,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the batched crypto kernels take minutes to
-# compile on CPU; cache them across pytest processes.
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# compile on CPU; cache them across pytest processes. Host-fingerprinted
+# dir (charon_tpu/jaxcache.py): XLA:CPU AOT entries are not portable
+# across machines — a foreign-host cache is worse than a cold one.
+from charon_tpu import jaxcache
+
+jaxcache.configure(jax, cpu=True)

@@ -137,3 +137,78 @@ def test_lock_verify_rejects_tampering():
     )
     with pytest.raises(ValueError):
         bad.verify(pubs)
+
+
+def test_definition_version_gate():
+    """Multi-revision compatibility gate (ref: dkg/dkg.go:108-116):
+    a previous-revision (v1.0) document parses with stable semantics, an
+    unknown revision is rejected up-front, and the current revision
+    round-trips its added field."""
+    import pytest
+
+    from charon_tpu.cluster.definition import (
+        DEFINITION_VERSION,
+        SUPPORTED_VERSIONS,
+        ClusterDefinition,
+        Operator,
+    )
+
+    ops = tuple(
+        Operator(address=f"op-{i}", enr=f"enr:legacy:{'%02x' % i * 33}")
+        for i in range(4)
+    )
+    # a v1.0-era document: no version-1.1 fields present at all
+    v10_json = {
+        "name": "legacy",
+        "uuid": "00000000-0000-0000-0000-00000000abcd",
+        "version": "ctpu/v1.0",
+        "timestamp": "2025-06-01T00:00:00Z",
+        "num_validators": 1,
+        "threshold": 3,
+        "fork_version": "0x00000000",
+        "fee_recipient_address": "",
+        "withdrawal_address": "",
+        "dkg_algorithm": "frost",
+        "creator_address": "",
+        "operators": [op.to_json() for op in ops],
+    }
+    d10 = ClusterDefinition.from_json(v10_json)
+    assert d10.version == "ctpu/v1.0"
+    # v1.0 payload/hash must not contain the v1.1 field
+    assert "consensus_protocol" not in d10.config_payload()
+    # embedded config_hash verification exercises the same stability
+    v10_json["config_hash"] = "0x" + d10.config_hash().hex()
+    assert ClusterDefinition.from_json(v10_json).config_hash() == d10.config_hash()
+
+    # a consensus_protocol smuggled into a signed v1.0 JSON is outside
+    # the v1.0 config hash -> unauthenticated -> ignored on parse
+    smuggled = dict(v10_json, consensus_protocol="attacker/9.9")
+    assert ClusterDefinition.from_json(smuggled).consensus_protocol == ""
+
+    # unknown revision: rejected with the supported list in the error
+    bad = dict(v10_json, version="ctpu/v9.9")
+    bad.pop("config_hash")
+    with pytest.raises(ValueError, match="unsupported cluster definition"):
+        ClusterDefinition.from_json(bad)
+
+    # current revision: the added field is signed and round-trips
+    d11 = ClusterDefinition(
+        name="current",
+        num_validators=1,
+        threshold=3,
+        fork_version="0x00000000",
+        operators=ops,
+        consensus_protocol="qbft/2.0.0",
+    )
+    assert d11.version == DEFINITION_VERSION in SUPPORTED_VERSIONS
+    assert d11.config_payload()["consensus_protocol"] == "qbft/2.0.0"
+    rt = ClusterDefinition.from_json(d11.to_json())
+    assert rt.consensus_protocol == "qbft/2.0.0"
+    assert rt.config_hash() == d11.config_hash()
+    # the field is hash-covered: changing it changes the config hash
+    from dataclasses import replace
+
+    assert (
+        replace(d11, consensus_protocol="other").config_hash()
+        != d11.config_hash()
+    )

@@ -275,9 +275,16 @@ def test_sigagg_recombines_and_verifies():
 # -- aggsigdb ----------------------------------------------------------------
 
 
-def test_aggsigdb_store_await():
+def _aggsigdb_impls():
+    from charon_tpu.core.aggsigdb import AggSigDBLoop, AggSigDBV2
+
+    return [AggSigDBV2, AggSigDBLoop]
+
+
+@pytest.mark.parametrize("impl_cls", _aggsigdb_impls())
+def test_aggsigdb_store_await(impl_cls):
     async def run():
-        db = AggSigDB()
+        db = impl_cls()
         duty = Duty(5, DutyType.RANDAO)
         data = d.SignedData("randao", 0, b"\x05" * 96)
         task = asyncio.create_task(db.await_(duty, PK))
@@ -285,18 +292,24 @@ def test_aggsigdb_store_await():
         await db.store(duty, PK, data)
         got = await asyncio.wait_for(task, 1)
         assert got.signature == data.signature
+        # idempotent re-store; conflicting aggregate rejected
+        await db.store(duty, PK, data)
+        bad = d.SignedData("randao", 0, b"\x06" * 96)
+        with pytest.raises(ValueError):
+            await db.store(duty, PK, bad)
 
     asyncio.run(run())
 
 
-def test_aggsigdb_waiters_fail_at_expiry():
+@pytest.mark.parametrize("impl_cls", _aggsigdb_impls())
+def test_aggsigdb_waiters_fail_at_expiry(impl_cls):
     """A waiter for an aggregate that never arrives is FAILED when the
     deadliner trims the duty, instead of hanging until HTTP timeout
     (VERDICT r3 weak #6; ref: aggsigdb memory_v2 trim errors queries)."""
-    from charon_tpu.core.aggsigdb import AggSigDB, DutyExpiredError
+    from charon_tpu.core.aggsigdb import DutyExpiredError
 
     async def run():
-        db = AggSigDB()
+        db = impl_cls()
         duty = Duty(5, DutyType.RANDAO)
         pk = PubKey("0x" + "ab" * 48)
         waiter = asyncio.create_task(db.await_(duty, pk))
@@ -306,9 +319,60 @@ def test_aggsigdb_waiters_fail_at_expiry():
             await asyncio.wait_for(waiter, timeout=5)
         # an unrelated duty's waiter is untouched
         other = asyncio.create_task(db.await_(Duty(6, DutyType.RANDAO), pk))
-        await asyncio.sleep(0)
+        await asyncio.sleep(0.01)
         db.trim(duty)
+        await asyncio.sleep(0.01)
         assert not other.done()
         other.cancel()
+
+    asyncio.run(run())
+
+
+def test_aggsigdb_selected_by_feature_flag():
+    """The AGG_SIG_DB_V2 flag (alpha, default off — ref:
+    featureset.go:56) gates which implementation app wiring gets."""
+    from charon_tpu.app import featureset
+    from charon_tpu.core.aggsigdb import (
+        AggSigDBLoop,
+        AggSigDBV2,
+        new_agg_sigdb,
+    )
+
+    featureset.init(featureset.Status.STABLE)
+    try:
+        assert isinstance(new_agg_sigdb(), AggSigDBLoop)
+        featureset.init(
+            featureset.Status.STABLE,
+            enable=[featureset.Feature.AGG_SIG_DB_V2],
+        )
+        assert isinstance(new_agg_sigdb(), AggSigDBV2)
+        featureset.init(featureset.Status.ALPHA)  # alpha rollout enables it
+        assert isinstance(new_agg_sigdb(), AggSigDBV2)
+    finally:
+        featureset.init(featureset.Status.STABLE)
+
+
+def test_aggsigdb_loop_survives_cancelled_store_ack():
+    """A caller cancelling its store() (e.g. via wait_for timeout) while
+    the command is queued must not crash the actor task — later
+    commands must still be processed."""
+    from charon_tpu.core.aggsigdb import AggSigDBLoop
+
+    async def run():
+        db = AggSigDBLoop()
+        duty = Duty(5, DutyType.RANDAO)
+        data = d.SignedData("randao", 0, b"\x05" * 96)
+        # enqueue a store and cancel its ack before the actor runs
+        task = asyncio.create_task(db.store(duty, PK, data))
+        await asyncio.sleep(0)  # task enqueues the command, then awaits
+        task.cancel()
+        # the actor must survive and serve later commands normally
+        await db.store(duty, PK, data)
+        # same for a cancelled QUERY whose value is already stored
+        q = asyncio.create_task(db.await_(duty, PK))
+        await asyncio.sleep(0)
+        q.cancel()
+        got = await asyncio.wait_for(db.await_(duty, PK), 1)
+        assert got.signature == data.signature
 
     asyncio.run(run())

@@ -303,3 +303,35 @@ def test_verifier_async_routes_through_plane():
 
     asyncio.run(main())
     assert fake.verify_calls == 1
+
+
+def test_host_bug_errors_do_not_burn_the_msm_rung():
+    """A host-side bug class (TypeError etc.) escaping the flush must NOT
+    permanently disable the process-wide MSM fast path — the per-lane
+    path would hit the same bug (ADVICE r4: gate the rung on
+    device/compile error types)."""
+    from charon_tpu import tbls as tbls_mod
+    from charon_tpu.ops import msm as MSM
+
+    impl = PythonImpl()
+
+    class BuggyPlane(FakePlane):
+        def verify_host(self, pks, msgs, sigs, rng=None):
+            raise TypeError("tracer shape bug")
+
+    plane = SlotCoalescer(
+        BuggyPlane(T), window=0.01, plane_factory=lambda: FakePlane(T)
+    )
+
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    root = b"\x77" * 32
+    sig = impl.sign(sk, root)
+
+    try:
+        assert MSM.msm_active()
+        with pytest.raises(tbls_mod.TblsError, match="flush failed"):
+            asyncio.run(plane.verify([(pk, root, sig)]))
+        assert MSM.msm_active(), "host bug must not flip the MSM family"
+    finally:
+        MSM.set_msm(None)

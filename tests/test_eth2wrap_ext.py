@@ -222,3 +222,29 @@ def test_multiclient_prefers_lower_latency_when_errors_tie():
         assert fast.calls == before + 1 and slow.calls == 1
 
     asyncio.run(main())
+
+
+def test_expbackoff_schedule():
+    """The dedicated util's pure schedule (ref: expbackoff.go:145
+    Backoff): exponential growth, max cap, deterministic jitter."""
+    from charon_tpu.app import expbackoff as eb
+
+    class FixedRng:
+        def __init__(self, v):
+            self.v = v
+
+        def random(self):
+            return self.v
+
+    cfg = eb.Config(base_delay=1.0, multiplier=2.0, jitter=0.2, max_delay=10.0)
+    mid = FixedRng(0.5)  # jitter factor 1.0
+    assert eb.backoff_delay(cfg, 0, rng=mid) == pytest.approx(1.0)
+    assert eb.backoff_delay(cfg, 1, rng=mid) == pytest.approx(2.0)
+    assert eb.backoff_delay(cfg, 3, rng=mid) == pytest.approx(8.0)
+    assert eb.backoff_delay(cfg, 10, rng=mid) == pytest.approx(10.0)  # cap
+    # jitter bounds: r=0 -> (1-jitter)x, r=1 -> (1+jitter)x
+    assert eb.backoff_delay(cfg, 0, rng=FixedRng(0.0)) == pytest.approx(0.8)
+    assert eb.backoff_delay(cfg, 0, rng=FixedRng(1.0)) == pytest.approx(1.2)
+    # presets match the reference's configs (expbackoff.go:33,41)
+    assert eb.DEFAULT_CONFIG.max_delay == 120.0
+    assert eb.FAST_CONFIG.base_delay == 0.1

@@ -22,7 +22,7 @@ from charon_tpu.testutil.golden import require_golden_bytes, require_golden_json
 _KEY = k1util.private_key_from_bytes(b"\x11" * 32)
 
 
-def _defn() -> ClusterDefinition:
+def _defn(version: str = "ctpu/v1.0") -> ClusterDefinition:
     return ClusterDefinition(
         name="golden",
         num_validators=2,
@@ -34,10 +34,14 @@ def _defn() -> ClusterDefinition:
         ),
         uuid="00000000-0000-0000-0000-000000000000",
         timestamp="2026-01-01T00:00:00Z",
+        version=version,
     )
 
 
 def test_definition_hashes_golden():
+    # the v1.0 golden freezes the ORIGINAL format revision: a v1.0
+    # document's hashes must never move, whatever the current revision
+    # adds (ref: cluster hashes are per-version, definition.go)
     d = _defn()
     require_golden_json(
         __file__,
@@ -46,6 +50,18 @@ def test_definition_hashes_golden():
             "config_hash": "0x" + d.config_hash().hex(),
             "definition_hash": "0x" + d.definition_hash().hex(),
             "eip712_config_digest": "0x" + d.config_signature_digest().hex(),
+        },
+    )
+
+
+def test_definition_hashes_golden_v1_1():
+    d = _defn(version="ctpu/v1.1")
+    require_golden_json(
+        __file__,
+        "definition_hashes_v1_1.json",
+        {
+            "config_hash": "0x" + d.config_hash().hex(),
+            "definition_hash": "0x" + d.definition_hash().hex(),
         },
     )
 

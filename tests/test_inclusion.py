@@ -2,7 +2,9 @@
 
 Mirrors ref: core/tracker/inclusion.go (+ inclusion_internal_test.go):
 included attestations/aggregates/proposals are reported with their delay;
-dropped broadcasts are reported missed after INCL_CHECK_LAG slots.
+dropped broadcasts are reported missed after INCL_MISSED_LAG slots;
+blocks are inspected only once INCL_CHECK_LAG slots deep (reorg lag);
+synthetic proposals are reported included at submit time.
 """
 
 from __future__ import annotations
@@ -16,7 +18,11 @@ from charon_tpu.core.eth2data import (
     Checkpoint,
     SignedData,
 )
-from charon_tpu.core.inclusion import INCL_CHECK_LAG, InclusionChecker
+from charon_tpu.core.inclusion import (
+    INCL_CHECK_LAG,
+    INCL_MISSED_LAG,
+    InclusionChecker,
+)
 from charon_tpu.core.types import Duty, DutyType
 from charon_tpu.testutil.beaconmock import BeaconMock
 
@@ -42,18 +48,18 @@ def test_attestation_included_with_delay():
     async def run():
         beacon = BeaconMock()
         reports = []
-        checker = InclusionChecker(beacon, on_report=reports.append)
+        checker = InclusionChecker(beacon, on_report=reports.append, check_lag=1)
         bcast = Broadcaster(beacon=beacon)
         bcast.subscribe(checker.submitted)
 
         duty, data_set = _att_duty(beacon, slot=10)
         await bcast.broadcast(duty, data_set)
 
-        # blocks trail the tick by one slot: the slot-11 tick inspects
-        # block 10, which carries the pooled attestation
-        await checker.on_slot(_Slot(11))
+        # an attestation for slot 10 lands earliest in block 11, which
+        # the check_lag=1 checker inspects at the slot-12 tick
+        await checker.on_slot(_Slot(12))
         assert len(reports) == 1
-        assert reports[0].included and reports[0].delay_slots == 0
+        assert reports[0].included and reports[0].delay_slots == 1
         assert checker.included_total == 1 and checker.missed_total == 0
 
     asyncio.run(run())
@@ -64,18 +70,20 @@ def test_dropped_attestation_reported_missed():
         beacon = BeaconMock()
         beacon.drop_inclusions = True  # chain never includes submissions
         reports = []
-        checker = InclusionChecker(beacon, on_report=reports.append)
+        checker = InclusionChecker(beacon, on_report=reports.append, check_lag=1)
         bcast = Broadcaster(beacon=beacon)
         bcast.subscribe(checker.submitted)
 
         duty, data_set = _att_duty(beacon, slot=10)
         await bcast.broadcast(duty, data_set)
 
-        # within the lag window: still pending, no report
-        await checker.on_slot(_Slot(10 + INCL_CHECK_LAG))
+        # within the lag window: still pending, no report. Expiry is
+        # judged against the CHECKED frontier (head - check_lag), so the
+        # full missed_lag window stays inspectable before a miss verdict
+        await checker.on_slot(_Slot(10 + INCL_MISSED_LAG + 1))
         assert reports == []
-        # one slot past the lag: reported missed
-        await checker.on_slot(_Slot(10 + INCL_CHECK_LAG + 1))
+        # frontier past the lag: reported missed
+        await checker.on_slot(_Slot(10 + INCL_MISSED_LAG + 2))
         assert len(reports) == 1
         assert not reports[0].included
         assert checker.missed_total == 1
@@ -87,7 +95,7 @@ def test_proposal_included_by_block_root():
     async def run():
         beacon = BeaconMock()
         reports = []
-        checker = InclusionChecker(beacon, on_report=reports.append)
+        checker = InclusionChecker(beacon, on_report=reports.append, check_lag=1)
         bcast = Broadcaster(beacon=beacon)
         bcast.subscribe(checker.submitted)
 
@@ -114,7 +122,7 @@ def test_wrong_bits_not_counted_as_included():
         beacon = BeaconMock()
         beacon.drop_inclusions = True
         reports = []
-        checker = InclusionChecker(beacon, on_report=reports.append)
+        checker = InclusionChecker(beacon, on_report=reports.append, check_lag=1)
 
         data = AttestationData(
             slot=5,
@@ -136,5 +144,105 @@ def test_wrong_bits_not_counted_as_included():
         ]
         await checker.on_slot(_Slot(7))  # inspects block 6
         assert reports == []  # not included: our bit 1 is not covered
+
+    asyncio.run(run())
+
+
+def test_reorg_lag_defers_block_inspection():
+    """With the production check lag, a block is only inspected once it
+    is INCL_CHECK_LAG slots deep (ref: inclusion.go:28 reorg
+    mitigation)."""
+
+    async def run():
+        beacon = BeaconMock()
+        reports = []
+        checker = InclusionChecker(beacon, on_report=reports.append)
+        bcast = Broadcaster(beacon=beacon)
+        bcast.subscribe(checker.submitted)
+
+        duty, data_set = _att_duty(beacon, slot=10)
+        await bcast.broadcast(duty, data_set)
+
+        # the attestation lands in block 11; ticks up to slot
+        # 11+INCL_CHECK_LAG-1 must NOT have inspected block 11 yet
+        for s in range(11, 11 + INCL_CHECK_LAG):
+            await checker.on_slot(_Slot(s))
+        assert reports == []
+        await checker.on_slot(_Slot(11 + INCL_CHECK_LAG))
+        assert len(reports) == 1 and reports[0].included
+
+    asyncio.run(run())
+
+
+def test_synthetic_proposal_reported_included_at_submit():
+    """A synthetic proposal (fabricated, swallowed at submit) must be
+    reported included immediately, never tracked toward a false miss
+    (ref: inclusion.go:80 Submitted's IsSyntheticProposal branch)."""
+
+    async def run():
+        from charon_tpu.app.eth2wrap import SyntheticProposerClient
+
+        beacon = BeaconMock()
+        synth = SyntheticProposerClient(beacon)
+        reports = []
+        checker = InclusionChecker(synth, on_report=reports.append, check_lag=1)
+        bcast = Broadcaster(beacon=synth)
+        bcast.subscribe(checker.submitted)
+
+        proposal = {
+            "slot": 12,
+            "synthetic": True,
+            "body": {"randao_reveal": "00"},
+        }
+        duty = Duty(slot=12, type=DutyType.PROPOSER)
+        data_set = {b"\xdd" * 48: SignedData("block", proposal, b"\x44" * 96)}
+        await bcast.broadcast(duty, data_set)
+
+        # reported included at submit time, nothing pending
+        assert len(reports) == 1
+        assert reports[0].included and reports[0].synthetic
+        assert checker._pending == []
+        # the beacon never saw a submitted proposal
+        assert synth.synthetic_submitted == 1
+        # ...and slots far past the missed lag never produce a miss
+        await checker.on_slot(_Slot(12 + INCL_MISSED_LAG + 1))
+        assert checker.missed_total == 0
+
+    asyncio.run(run())
+
+
+def test_inclusion_feeds_tracker_counters():
+    """Inclusion results land in the tracker's chain-inclusion counters
+    (ref: tracker.go:815 InclusionChecked -> chainInclusion step)."""
+    from charon_tpu.core.tracker import Step, Tracker
+
+    async def run():
+        beacon = BeaconMock()
+        tracker = Tracker([1, 2, 3])
+        checker = InclusionChecker(beacon, check_lag=1)
+        checker.subscribe(
+            lambda r: tracker.inclusion_checked(r.duty, r.pubkey, r.included)
+        )
+        bcast = Broadcaster(beacon=beacon)
+        bcast.subscribe(checker.submitted)
+
+        duty, data_set = _att_duty(beacon, slot=10)
+        await bcast.broadcast(duty, data_set)
+        await checker.on_slot(_Slot(12))  # inspects block 11
+        assert tracker.inclusion_included_total[DutyType.ATTESTER] == 1
+
+        beacon.drop_inclusions = True
+        duty2, data2 = _att_duty(beacon, slot=20)
+        await bcast.broadcast(duty2, data2)
+        await checker.on_slot(_Slot(20 + INCL_MISSED_LAG + 2))
+        assert tracker.inclusion_missed_total[DutyType.ATTESTER] == 1
+        assert (
+            tracker.failed_total[(DutyType.ATTESTER, Step.CHAIN_INCLUSION)]
+            == 1
+        )
+        # key shape matches every consumer's 2-tuple unpack (run.py
+        # health sampler iterates `for (dtype, _), cnt in ...`)
+        for key in tracker.failed_total:
+            assert len(key) == 2
 
     asyncio.run(run())

@@ -109,7 +109,7 @@ def test_qbft_over_tcp():
         nodes = await make_mesh(4)
         try:
             nets = [TcpQbftNet(node) for node in nodes]
-            cons = [QBFTConsensus(nets[i], 4, round_timeout=0.5) for i in range(4)]
+            cons = [QBFTConsensus(nets[i], 4, round_timeout=0.5, timer="inc") for i in range(4)]
             decided = []
 
             for c in cons:

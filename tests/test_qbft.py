@@ -176,7 +176,7 @@ def test_consensus_sniffer_and_debug_endpoint():
 
     async def main():
         net = MemMsgNet()
-        nodes = [QBFTConsensus(net, 4, round_timeout=0.2) for _ in range(4)]
+        nodes = [QBFTConsensus(net, 4, round_timeout=0.2, timer="inc") for _ in range(4)]
         duty = Duty(slot=9, type=DutyType.ATTESTER)
         await asyncio.wait_for(
             asyncio.gather(

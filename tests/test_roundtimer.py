@@ -1,0 +1,175 @@
+"""Round-timer strategies: increasing vs eager-double-linear.
+
+Mirrors the reference's two switchable timer strategies and their
+distinct restart semantics (ref: core/consensus/utils/roundtimer.go:17-19
+constants, :136-152 double-instead-of-reset, roundtimer_test.go), plus a
+round-change-storm liveness run under each strategy.
+"""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core import qbft
+from charon_tpu.core.consensus_qbft import MemMsgNet, QBFTConsensus
+
+from test_qbft import Net
+
+
+def test_increasing_timer_resets_on_rearm():
+    t = qbft.IncreasingRoundTimer(0.75, 0.25)
+    assert t.type == "inc"
+    assert t.duration(1, 100.0) == pytest.approx(1.0)
+    assert t.duration(2, 100.0) == pytest.approx(1.25)
+    # re-arming the same round later gives the FULL timeout again (reset)
+    assert t.duration(1, 105.0) == pytest.approx(1.0)
+
+
+def test_dlinear_timer_doubles_instead_of_resetting():
+    t = qbft.DoubleEagerLinearRoundTimer(1.0)
+    assert t.type == "eager_dlinear"
+    # first arm of round 2 at now=100: linear timeout, deadline 102
+    assert t.duration(2, 100.0) == pytest.approx(2.0)
+    # re-arm at now=101.5 (justified pre-prepare arrived): deadline
+    # extends to first_deadline + linear = 104, NOT now + 2 = 103.5 —
+    # the round end-time stays aligned with the round START time
+    assert t.duration(2, 101.5) == pytest.approx(2.5)
+    # a re-arm after the extended deadline has passed clamps at zero
+    assert t.duration(2, 105.0) == 0.0
+    # other rounds have independent first-deadline state
+    assert t.duration(3, 110.0) == pytest.approx(3.0)
+
+
+def test_dlinear_per_instance_state_isolated():
+    # two instances (duties) must not share first-deadline state — the
+    # factory in Definition.new_timer is called per qbft.run
+    mk = lambda: qbft.DoubleEagerLinearRoundTimer(1.0)  # noqa: E731
+    a, b = mk(), mk()
+    assert a.duration(1, 100.0) == pytest.approx(1.0)
+    # b arming round 1 later is a FIRST arm for b, not a double
+    assert b.duration(1, 100.9) == pytest.approx(1.0)
+
+
+def _run_cluster(n, values, new_timer, drop=None, skip=(), timeout=10.0):
+    net = Net(n, drop=drop)
+    defn = qbft.Definition(
+        nodes=n,
+        leader=lambda inst, rnd: (hash(inst) + rnd) % n,
+        new_timer=new_timer,
+    )
+    tasks = [
+        asyncio.create_task(
+            qbft.run(defn, net.transports[i], "duty-1", i, values[i])
+        )
+        for i in range(n)
+        if i not in skip
+    ]
+    return defn, asyncio.wait_for(asyncio.gather(*tasks), timeout)
+
+
+def test_cluster_decides_under_dlinear_timer():
+    async def run():
+        _, gathered = _run_cluster(
+            4,
+            [f"v{i}" for i in range(4)],
+            lambda: qbft.DoubleEagerLinearRoundTimer(0.3),
+        )
+        decided = await gathered
+        assert len(set(decided)) == 1
+
+    asyncio.run(run())
+
+
+def test_round_change_storm_liveness_both_strategies():
+    """Silent round-1 leader forces a cluster-wide round-change storm;
+    both timer strategies must converge on the round-2 leader's value
+    (ref: strategysim_internal_test.go exercises timer strategies under
+    round changes)."""
+
+    async def run(new_timer):
+        leader1 = (hash("duty-1") + 1) % 4
+
+        def drop(src, dst, msg):
+            return src == leader1
+
+        defn, gathered = _run_cluster(
+            4,
+            [f"v{i}" for i in range(4)],
+            new_timer,
+            drop=drop,
+            skip={leader1},
+        )
+        decided = await gathered
+        assert len(set(decided)) == 1
+        assert decided[0] == f"v{defn.leader('duty-1', 2)}"
+
+    asyncio.run(run(lambda: qbft.IncreasingRoundTimer(0.15, 0.15)))
+    asyncio.run(run(lambda: qbft.DoubleEagerLinearRoundTimer(0.15)))
+
+
+def test_justified_preprepare_rearms_timer_once():
+    """Every node re-arms its round-1 timer when the justified
+    pre-prepare fires (ref: qbft.go:318-319), exactly once (duplicate
+    rule suppression), and the dlinear re-arm EXTENDS the deadline."""
+    calls: dict[int, list[tuple[int, float]]] = {}
+
+    class Recording(qbft.DoubleEagerLinearRoundTimer):
+        def __init__(self, node):
+            super().__init__(0.5)
+            self.node = node
+
+        def duration(self, rnd, now):
+            d = super().duration(rnd, now)
+            calls.setdefault(self.node, []).append((rnd, d))
+            return d
+
+    async def run():
+        net = Net(4)
+        values = [f"v{i}" for i in range(4)]
+        seq = iter(range(4))
+        defn = qbft.Definition(
+            nodes=4,
+            leader=lambda inst, rnd: (hash(inst) + rnd) % 4,
+            new_timer=lambda: Recording(next(seq)),
+        )
+        tasks = [
+            asyncio.create_task(
+                qbft.run(defn, net.transports[i], "duty-1", i, values[i])
+            )
+            for i in range(4)
+        ]
+        decided = await asyncio.wait_for(asyncio.gather(*tasks), 10)
+        assert len(set(decided)) == 1
+
+    asyncio.run(run())
+    for node, arms in calls.items():
+        r1 = [d for (rnd, d) in arms if rnd == 1]
+        # initial arm + exactly one justified-pre-prepare re-arm
+        assert len(r1) == 2, (node, arms)
+        # the re-arm extended the deadline (duration past the first
+        # 0.5 s window, toward the doubled 1.0 s one)
+        assert 0.5 <= r1[0] <= 0.5 + 1e-6
+        assert r1[1] > 0.4, (node, arms)
+
+
+def test_adapter_selects_timer_from_featureset():
+    from charon_tpu.app import featureset
+
+    featureset.init(featureset.Status.STABLE)
+    try:
+        net = MemMsgNet()
+        # default: EAGER_DOUBLE_LINEAR is stable → dlinear, mirroring
+        # ref featureset.go:53
+        node = QBFTConsensus(net, 4)
+        assert node.timer_type == "eager_dlinear"
+        # explicit disable falls back to the increasing timer
+        featureset.init(
+            featureset.Status.STABLE,
+            disable=[featureset.Feature.EAGER_DOUBLE_LINEAR],
+        )
+        node2 = QBFTConsensus(MemMsgNet(), 4)
+        assert node2.timer_type == "inc"
+        with pytest.raises(ValueError):
+            QBFTConsensus(MemMsgNet(), 4, timer="bogus")
+    finally:
+        featureset.init(featureset.Status.STABLE)

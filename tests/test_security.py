@@ -48,7 +48,7 @@ def _make_cluster(n=4, timeout=0.15):
     nodes = [
         QBFTConsensus(
             net, n, round_timeout=timeout, round_increase=timeout,
-            privkey=privs[i], pubkeys=pubs,
+            privkey=privs[i], pubkeys=pubs, timer="inc",
         )
         for i in range(n)
     ]
