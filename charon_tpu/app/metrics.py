@@ -72,6 +72,40 @@ class ClusterMetrics:
             "Per-peer duty participation (dedup'd by validator)",
             ["duty", "peer_share"],
         )
+        self.tracker_failed_validators = counter(
+            "core_tracker_failed_validators_total",
+            "Per-validator signing failures (expected pubkeys whose "
+            "partials never reached threshold), by duty type and reason",
+            ["duty", "reason"],
+        )
+        self.inclusion_checked = counter(
+            "core_tracker_inclusion_total",
+            "On-chain inclusion results for broadcast duties "
+            "(ref: core/tracker/inclusion.go inclusion metrics)",
+            ["duty", "result"],
+        )
+        self.inclusion_delay = Gauge(
+            "core_tracker_inclusion_delay_slots",
+            "Most recent on-chain inclusion delay in slots",
+            labels,
+            registry=self.registry,
+        )
+        self.consensus_decided_rounds = Gauge(
+            "core_consensus_decided_rounds",
+            "Round the most recent consensus instance decided in, by "
+            "duty type and round-timer strategy (ref: consensus metrics "
+            "SetDecidedRounds)",
+            labels + ["duty", "timer"],
+            registry=self.registry,
+        )
+        self.consensus_duration = Gauge(
+            "core_consensus_duration_seconds",
+            "Wall seconds the most recent consensus instance took, by "
+            "duty type and round-timer strategy (ref: consensus metrics "
+            "ObserveConsensusDuration)",
+            labels + ["duty", "timer"],
+            registry=self.registry,
+        )
         self.peer_ping = Gauge(
             "p2p_ping_success",
             "Peer ping success",

@@ -345,6 +345,17 @@ async def build_node(config: Config) -> Node:
         qbft_net, n, privkey=k1_key, pubkeys=op_pubkeys, gater=duty_gater
     )
     consensus = ConsensusController(qbft_consensus)
+
+    def _consensus_stats(s):
+        d = str(s["duty"].type.name).lower()
+        metrics.labels(
+            metrics.consensus_decided_rounds, d, s["timer"]
+        ).set(s["round"])
+        metrics.labels(
+            metrics.consensus_duration, d, s["timer"]
+        ).set(s["duration"])
+
+    qbft_consensus.on_decided_stats = _consensus_stats
     vapi = ValidatorAPI(
         share_idx=share_idx,
         pubshares=pubshares_by_idx[share_idx],
@@ -364,7 +375,9 @@ async def build_node(config: Config) -> Node:
         validators,
         slots_per_epoch=config.slots_per_epoch,
     )
-    tracker = Tracker(peer_share_indices=list(range(1, n + 1)))
+    tracker = Tracker(
+        peer_share_indices=list(range(1, n + 1)), threshold=t
+    )
 
     wire(
         scheduler=scheduler,
@@ -404,6 +417,17 @@ async def build_node(config: Config) -> Node:
                 peer_share=share,
                 count=cnt,
             )
+        for pk, why in report.failed_pubkeys.items():
+            metrics.labels(
+                metrics.tracker_failed_validators, d, why.value
+            ).inc()
+            log.warn(
+                "validator failed to assemble threshold partials",
+                topic="tracker",
+                duty=str(report.duty),
+                pubkey=str(pk)[:18],
+                reason=why.value,
+            )
 
     tracker.subscribe(_report_metrics)
 
@@ -431,7 +455,18 @@ async def build_node(config: Config) -> Node:
 
         from charon_tpu.app import version as version_mod
 
+        from charon_tpu.core.priority import order_protocol_prefs
+
         prio_exchange = P2PPriorityExchange(p2p_node)
+
+        def _protocol_prefs() -> list[str]:
+            # v1.1+ definitions carry an operator-signed cluster-level
+            # protocol preference that outranks the node default
+            return order_protocol_prefs(
+                [p.protocol_id for p in consensus.registered()],
+                getattr(lock.definition, "consensus_protocol", ""),
+            )
+
         prioritiser = Prioritiser(
             # the scheduler never emits INFO_SYNC, so the Prioritiser
             # itself registers its duty for expiry — consensus instance,
@@ -442,9 +477,7 @@ async def build_node(config: Config) -> Node:
             exchange=prio_exchange.exchange,
             consensus=consensus,
             topics_fn=lambda: {
-                InfoSync.TOPIC_PROTOCOL: [
-                    p.protocol_id for p in consensus.registered()
-                ],
+                InfoSync.TOPIC_PROTOCOL: _protocol_prefs(),
                 InfoSync.TOPIC_VERSION: [version_mod.VERSION],
             },
         )
@@ -462,10 +495,19 @@ async def build_node(config: Config) -> Node:
         bcast.subscribe(inclusion.submitted)
         scheduler.subscribe_slots(inclusion.on_slot)
         # feed results back into the tracker's chain-inclusion step
-        # counters (ref: app/app.go:562 wires track.InclusionChecked)
-        inclusion.subscribe(
-            lambda r: tracker.inclusion_checked(r.duty, r.pubkey, r.included)
-        )
+        # counters and the metrics catalogue
+        # (ref: app/app.go:562 wires track.InclusionChecked)
+        def _on_inclusion(r):
+            tracker.inclusion_checked(r.duty, r.pubkey, r.included)
+            metrics.labels(
+                metrics.inclusion_checked,
+                str(r.duty.type.name).lower(),
+                "included" if r.included else "missed",
+            ).inc()
+            if r.included:
+                metrics.labels(metrics.inclusion_delay).set(r.delay_slots)
+
+        inclusion.subscribe(_on_inclusion)
 
     # in-process validator client for simnet runs (ref: app/vmock.go —
     # the reference wires validatormock when --simnet-validator-mock)

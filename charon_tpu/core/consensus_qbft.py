@@ -155,6 +155,10 @@ class QBFTConsensus:
         self._instances: dict[Duty, qbft.Transport] = {}
         self._running: dict[Duty, asyncio.Task] = {}
         self._decided: set[Duty] = set()
+        # most recent decide's {duty, round, duration, timer} + optional
+        # observer (run.py wires it into the metrics catalogue)
+        self.last_decided: dict | None = None
+        self.on_decided_stats = None
 
     def subscribe(self, sub: DecidedSub) -> None:
         self._subs.append(sub)
@@ -261,12 +265,27 @@ class QBFTConsensus:
         return task
 
     async def _run_instance(self, duty: Duty, tr: qbft.Transport, vhash) -> None:
+        import time as _time
+
+        stats: dict = {}
+        t0 = _time.monotonic()
         decided_hash = await qbft.run(
-            self.defn, tr, duty, self.node_idx, vhash
+            self.defn, tr, duty, self.node_idx, vhash, stats=stats
         )
         if duty in self._decided:
             return
         self._decided.add(duty)
+        # decided round + wall duration per timer strategy (ref:
+        # consensus metrics ObserveConsensusDuration / SetDecidedRounds
+        # labelled by timer type)
+        self.last_decided = {
+            "duty": duty,
+            "round": stats.get("round", 0),
+            "duration": _time.monotonic() - t0,
+            "timer": self.timer_type,
+        }
+        if self.on_decided_stats is not None:
+            self.on_decided_stats(self.last_decided)
         unsigned_set = self._values.get(duty, {}).get(decided_hash)
         if unsigned_set is None:
             raise RuntimeError(

@@ -320,6 +320,19 @@ class P2PPriorityExchange:
         return got
 
 
+def order_protocol_prefs(registered: list[str], preferred: str) -> list[str]:
+    """Supported protocols most-preferred first: a cluster-level
+    preference (the v1.1 definition's hash-covered consensus_protocol)
+    outranks the node default; an unsupported or empty preference leaves
+    the order untouched (ref: the cluster consensus preference feeds the
+    node's priority proposal ahead of its defaults)."""
+    prefs = list(registered)
+    if preferred in prefs:
+        prefs.remove(preferred)
+        prefs.insert(0, preferred)
+    return prefs
+
+
 def protocol_switcher(controller):
     """Priority subscriber that switches the consensus protocol to the
     cluster's top choice (ref: app/app.go:650-668)."""

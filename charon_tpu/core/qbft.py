@@ -217,15 +217,22 @@ async def run(
     node: int,
     value: Hashable | None,
     value_ch: asyncio.Future | None = None,
+    stats: dict | None = None,
 ) -> Hashable:
     """Run one QBFT instance until it decides; returns the decided value.
 
     `value` is this node's proposal input (may be None initially with a
     `value_ch` future supplying it later — the participate-then-propose
     pattern, ref: core/consensus/qbft/qbft.go Propose vs Participate).
-    """
+
+    `stats`, when given, receives `{"round": decided_round}` on decide —
+    the adapter feeds it into the decided-rounds metric (ref:
+    consensus metrics SetDecidedRounds per timer type)."""
     engine = _Engine(defn, transport, instance, node)
-    return await engine.run(value, value_ch)
+    result = await engine.run(value, value_ch)
+    if stats is not None:
+        stats["round"] = engine.round
+    return result
 
 
 class _Engine:

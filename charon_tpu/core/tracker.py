@@ -19,6 +19,7 @@ subscription edge (ref: core/tracking.go wraps via core.WithTracking).
 from __future__ import annotations
 
 import enum
+import math
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
@@ -185,6 +186,12 @@ class DutyReport:
     unexpected_shares: dict[int, int] = field(default_factory=dict)
     # pubkeys whose partials arrived under more than one message root
     inconsistent_pubkeys: list[PubKey] = field(default_factory=list)
+    # per-validator attribution (ref: the reference tracks events per
+    # (duty, pubkey) and reports each validator's failure separately):
+    # expected pubkeys whose partial signatures never reached the
+    # cluster threshold — populated even when the duty as a whole
+    # succeeded for the other validators (partial success)
+    failed_pubkeys: dict[PubKey, Reason] = field(default_factory=dict)
 
 
 ReportSub = Callable[[DutyReport], Awaitable[None] | None]
@@ -211,8 +218,15 @@ def _parsig_root(psig) -> bytes:
 class Tracker:
     """threshold/peers: for participation accounting."""
 
-    def __init__(self, peer_share_indices: list[int]) -> None:
+    def __init__(
+        self, peer_share_indices: list[int], threshold: int | None = None
+    ) -> None:
         self.peer_share_indices = list(peer_share_indices)
+        # partial-signature count an expected validator needs; defaults
+        # to the BFT quorum of the peer count
+        self.threshold = threshold or math.ceil(
+            2 * len(peer_share_indices) / 3
+        )
         self._steps: dict[Duty, set[Step]] = defaultdict(set)
         self._errors: dict[Duty, list[str]] = defaultdict(list)
         # duty -> pubkey -> msg root -> set of share indices
@@ -236,6 +250,7 @@ class Tracker:
         self.unexpected_total: dict[int, int] = defaultdict(int)
         self.inclusion_included_total: dict[DutyType, int] = defaultdict(int)
         self.inclusion_missed_total: dict[DutyType, int] = defaultdict(int)
+        self.pubkey_failures_total: dict[DutyType, int] = defaultdict(int)
 
     def subscribe(self, sub: ReportSub) -> None:
         self._subs.append(sub)
@@ -389,6 +404,37 @@ class Tracker:
         for idx in participation:
             self.participation_total[idx] += 1
 
+        # per-validator attribution: once the signing phase started
+        # (duty data stored), every expected pubkey should assemble a
+        # threshold of partials — those that did not are reported
+        # individually, including under a duty-level success (partial
+        # success: some validators signed, this one did not)
+        pubkey_failures: dict[PubKey, Reason] = {}
+        if expected and Step.DUTY_DB in steps:
+            for pk in expected:
+                roots = parsigs.get(pk)
+                if not roots:
+                    pubkey_failures[pk] = Reason.NO_LOCAL_PARTIAL
+                    continue
+                # aggregation needs a threshold of shares on ONE message
+                # root — a union across conflicting roots can never
+                # aggregate, so count per root
+                best = max(len(s) for s in roots.values())
+                total = len(set().union(*roots.values()))
+                if best >= self.threshold:
+                    continue
+                if total >= self.threshold:
+                    # enough shares overall but split across roots
+                    pubkey_failures[pk] = (
+                        Reason.PARSIG_INCONSISTENT_SYNC
+                        if duty.type in _EXPECT_INCONSISTENT
+                        else Reason.PARSIG_INCONSISTENT
+                    )
+                else:
+                    pubkey_failures[pk] = Reason.INSUFFICIENT_PARTIALS
+        if pubkey_failures:
+            self.pubkey_failures_total[duty.type] += len(pubkey_failures)
+
         report = DutyReport(
             duty=duty,
             success=success,
@@ -400,6 +446,7 @@ class Tracker:
             expected_per_peer=len(expected),
             unexpected_shares=dict(unexpected),
             inconsistent_pubkeys=inconsistent,
+            failed_pubkeys=pubkey_failures,
         )
         for sub in self._subs:
             res = sub(report)

@@ -104,8 +104,13 @@ def parse_effectiveness(body: bytes) -> dict[str, float]:
 async def _default_fetcher(url: str, headers: dict[str, str]) -> bytes:
     """Minimal HTTP/1.1 GET over asyncio streams."""
     parts = urlsplit(url)
-    port = parts.port or (443 if parts.scheme == "https" else 80)
-    reader, writer = await asyncio.open_connection(parts.hostname, port)
+    https = parts.scheme == "https"
+    port = parts.port or (443 if https else 80)
+    # ssl for https endpoints — the Authorization bearer token must
+    # never leave the host in cleartext
+    reader, writer = await asyncio.open_connection(
+        parts.hostname, port, ssl=True if https else None
+    )
     try:
         path = parts.path or "/"
         if parts.query:

@@ -12,7 +12,7 @@ import asyncio
 from dataclasses import dataclass, field
 
 from charon_tpu import tbls
-from charon_tpu.core.aggsigdb import AggSigDB
+from charon_tpu.core.aggsigdb import new_agg_sigdb
 from charon_tpu.core.bcast import Broadcaster
 from charon_tpu.core.consensus import ConsensusController, EchoConsensus
 from charon_tpu.core.dutydb import DutyDB
@@ -163,7 +163,8 @@ def _build_node(
     dutydb = DutyDB()
     parsigdb = ParSigDB(threshold=cluster.t)
     sigagg = SigAgg(threshold=cluster.t, fork=fork, slots_per_epoch=spe)
-    aggsigdb = AggSigDB()
+    # flag-selected impl, mirroring production wiring (run.py)
+    aggsigdb = new_agg_sigdb()
     bcast = Broadcaster(beacon=beacon, clock=beacon.clock())
     fetcher = Fetcher(beacon)
     if qbft_net is not None:
@@ -251,7 +252,9 @@ def _build_node(
         scheduler.subscribe_duties(on_duty)
 
     # inclusion checker (ref: core/tracker/inclusion.go wiring)
-    inclusion = InclusionChecker(beacon)
+    # check_lag=1: simnet runs span a handful of slots; the
+    # production 6-slot reorg lag would make the checker inert here
+    inclusion = InclusionChecker(beacon, check_lag=1)
     bcast.subscribe(inclusion.submitted)
     scheduler.subscribe_slots(inclusion.on_slot)
 

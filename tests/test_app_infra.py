@@ -644,3 +644,79 @@ def test_otlp_exporter_dead_collector_drops():
         pass
     exporter.shutdown()
     assert exporter.dropped >= 1 and exporter.exported == 0
+
+
+def test_tracker_per_pubkey_failure_attribution():
+    """Per-validator attribution (ref: the reference analyses events per
+    (duty, pubkey)): an expected pubkey whose partials never reached
+    threshold is reported individually, even when the duty as a whole
+    succeeded for the other validators."""
+    from charon_tpu.core.types import pubkey_from_bytes
+
+    async def run():
+        pk_ok = pubkey_from_bytes(b"\x01" * 48)
+        pk_short = pubkey_from_bytes(b"\x02" * 48)
+        pk_silent = pubkey_from_bytes(b"\x03" * 48)
+        duty = Duty(9, DutyType.ATTESTER)
+        tr = Tracker(peer_share_indices=[1, 2, 3, 4], threshold=3)
+        tr.duty_scheduled(duty, [pk_ok, pk_short, pk_silent])
+        for s in Step:
+            tr.step_event(duty, s)  # duty-level success
+        for idx in (1, 2, 3):
+            tr.partial_observed(duty, idx, pubkey=pk_ok, root=b"r")
+        tr.partial_observed(duty, 1, pubkey=pk_short, root=b"r")
+        report = await tr.duty_expired(duty)
+        assert report.success  # the duty (pk_ok) succeeded...
+        assert report.failed_pubkeys == {
+            pk_short: Reason.INSUFFICIENT_PARTIALS,  # 1 < threshold 3
+            pk_silent: Reason.NO_LOCAL_PARTIAL,  # zero partials
+        }
+        assert tr.pubkey_failures_total[DutyType.ATTESTER] == 2
+
+        # before the signing phase (no DUTY_DB step) nothing is
+        # attributed per pubkey — the duty-level reason covers it
+        duty2 = Duty(10, DutyType.ATTESTER)
+        tr.duty_scheduled(duty2, [pk_ok])
+        tr.step_event(duty2, Step.SCHEDULER)
+        report2 = await tr.duty_expired(duty2)
+        assert report2.failed_pubkeys == {}
+
+    asyncio.run(run())
+
+
+def test_tracker_per_pubkey_split_roots_flagged_inconsistent():
+    """Shares split across conflicting message roots can never
+    aggregate even if their union reaches threshold — attributed as
+    inconsistency, not missed (review r5: union-counting hid exactly
+    the inconsistency case)."""
+    from charon_tpu.core.types import pubkey_from_bytes
+
+    async def run():
+        pk = pubkey_from_bytes(b"\x04" * 48)
+        duty = Duty(11, DutyType.ATTESTER)
+        tr = Tracker(peer_share_indices=[1, 2, 3, 4], threshold=3)
+        tr.duty_scheduled(duty, [pk])
+        for s in Step:
+            tr.step_event(duty, s)
+        # {1,2} on root A, {3} on root B: union 3 >= threshold but no
+        # single root can aggregate
+        tr.partial_observed(duty, 1, pubkey=pk, root=b"A")
+        tr.partial_observed(duty, 2, pubkey=pk, root=b"A")
+        tr.partial_observed(duty, 3, pubkey=pk, root=b"B")
+        report = await tr.duty_expired(duty)
+        assert report.failed_pubkeys == {pk: Reason.PARSIG_INCONSISTENT}
+
+        # sync-committee duties expect disagreement: distinct reason
+        duty2 = Duty(12, DutyType.SYNC_MESSAGE)
+        tr.duty_scheduled(duty2, [pk])
+        for s in Step:
+            tr.step_event(duty2, s)
+        tr.partial_observed(duty2, 1, pubkey=pk, root=b"A")
+        tr.partial_observed(duty2, 2, pubkey=pk, root=b"A")
+        tr.partial_observed(duty2, 3, pubkey=pk, root=b"B")
+        report2 = await tr.duty_expired(duty2)
+        assert report2.failed_pubkeys == {
+            pk: Reason.PARSIG_INCONSISTENT_SYNC
+        }
+
+    asyncio.run(run())

@@ -148,3 +148,19 @@ def test_calculate_validation_rules():
         calculate(
             [msg(0, p=[str(i) for i in range(1000)])], quorum=1
         )
+
+
+def test_order_protocol_prefs_cluster_preference():
+    """A v1.1 definition's consensus_protocol outranks the node default;
+    unsupported/empty preferences leave the order untouched."""
+    from charon_tpu.core.priority import order_protocol_prefs
+
+    registered = ["qbft/2.0.0", "qbft/1.0.0"]
+    assert order_protocol_prefs(registered, "qbft/1.0.0") == [
+        "qbft/1.0.0",
+        "qbft/2.0.0",
+    ]
+    assert order_protocol_prefs(registered, "") == registered
+    assert order_protocol_prefs(registered, "raft/9") == registered
+    # original list untouched (no aliasing surprises)
+    assert registered == ["qbft/2.0.0", "qbft/1.0.0"]

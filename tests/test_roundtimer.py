@@ -173,3 +173,38 @@ def test_adapter_selects_timer_from_featureset():
             QBFTConsensus(MemMsgNet(), 4, timer="bogus")
     finally:
         featureset.init(featureset.Status.STABLE)
+
+
+def test_adapter_records_decided_stats():
+    """The adapter records decided round + duration per timer strategy
+    for the metrics catalogue (ref: consensus SetDecidedRounds /
+    ObserveConsensusDuration labelled by timer type)."""
+    from charon_tpu.core.types import Duty, DutyType
+
+    async def run():
+        net = MemMsgNet()
+        nodes = [
+            QBFTConsensus(net, 4, round_timeout=0.2, timer="inc")
+            for _ in range(4)
+        ]
+        decided = asyncio.Event()
+        stats_seen = []
+        nodes[0].on_decided_stats = stats_seen.append
+        async def on_decided(duty, v):
+            decided.set()
+
+        for node in nodes:
+            node.subscribe(on_decided)
+        duty = Duty(1, DutyType.ATTESTER)
+        await asyncio.gather(
+            *(n.propose(duty, {"pk": b"value"}) for n in nodes)
+        )
+        await asyncio.wait_for(decided.wait(), 5)
+        return nodes[0], stats_seen
+
+    node, stats_seen = asyncio.run(run())
+    assert node.last_decided is not None
+    assert node.last_decided["round"] >= 1
+    assert node.last_decided["timer"] == "inc"
+    assert node.last_decided["duration"] >= 0.0
+    assert stats_seen and stats_seen[0] is node.last_decided
