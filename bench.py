@@ -26,9 +26,12 @@ Budget discipline (round-1 bench timed out, VERDICT Weak #1):
   * ONE kernel is compiled per attempted batch size, after a tiny warmup
     batch; the persistent cache (.jax_cache, primed on this platform)
     makes the steady-state run seconds;
-  * batch sizes are attempted in descending order — a size whose program
-    crashes the TPU compiler (observed at >= 512 lanes for the per-lane
-    kernel) just falls through to the next;
+  * batch sizes sweep ASCENDING and the best completed measurement is
+    banked as each size finishes — a short live-tunnel window still
+    yields one TPU line, a size whose program crashes the compiler is
+    skipped, and a mid-sweep device wedge emits the banked best via the
+    result guard instead of hanging (the guard only arms off-CPU: on
+    the CPU fallback a long pause is just compile time);
   * every phase heartbeats with elapsed time.
 
 vs_baseline: measured device throughput divided by the single-threaded
@@ -298,6 +301,12 @@ def main() -> None:
 
     guard = {"deadline": None, "banked": None}
     per_size_budget = float(os.environ.get("CHARON_BENCH_SIZE_BUDGET", 900))
+    # The stall guard defends against the TPU tunnel wedging mid-bench
+    # (a dispatch that never returns). On the CPU platform the claim has
+    # already succeeded and nothing can wedge — a long pause is just
+    # XLA:CPU compile time on a 1-core host, and killing it produced a
+    # spurious 0.0 line in rehearsal. Never arm the guard on CPU.
+    guard_active = platform != "cpu"
 
     def _guard_loop():
         while True:
@@ -307,28 +316,65 @@ def main() -> None:
                 if guard["banked"] is not None:
                     hb("phase deadline passed; emitting banked best result")
                     print(guard["banked"], flush=True)
-                else:
-                    hb("phase deadline passed with nothing banked")
-                    print(
-                        json.dumps(
-                            {
-                                "metric": "batched_bls_verify",
-                                "value": 0.0,
-                                "unit": "sigs/sec",
-                                "vs_baseline": 0.0,
-                                "error": "device stalled mid-bench before "
-                                "any batch completed",
-                            }
-                        ),
-                        flush=True,
+                    os._exit(0)
+                # nothing banked: the device wedged before any batch
+                # completed. Re-exec for a fresh claim while the global
+                # claim budget lasts (same ladder as a pre-claim wedge);
+                # only past the budget emit the error line.
+                from bench_common import claim_retry_env
+
+                try:
+                    attempt = int(
+                        os.environ.get("CHARON_BENCH_CLAIM_ATTEMPT", "1")
                     )
+                except ValueError:
+                    attempt = 1  # malformed env must not kill the guard
+                updates = claim_retry_env(attempt)
+                hb(
+                    "phase deadline passed with nothing banked: "
+                    + (
+                        "re-exec for a fresh claim"
+                        if "CHARON_BENCH_CLAIM_ATTEMPT" in updates
+                        else "claim budget exhausted"
+                    )
+                )
+                # apply the ladder's updates in BOTH cases: a fresh TPU
+                # attempt inside the budget, or the CPU pin past it —
+                # the pinned re-exec still produces a real CPU-fallback
+                # measurement instead of a 0.0 line
+                os.environ.update(updates)
+                try:
+                    os.execv(sys.executable, [sys.executable] + sys.argv)
+                except OSError:
+                    pass
+                print(
+                    json.dumps(
+                        {
+                            "metric": "batched_bls_verify",
+                            "value": 0.0,
+                            "unit": "sigs/sec",
+                            "vs_baseline": 0.0,
+                            "error": "device stalled mid-bench before "
+                            "any batch completed, and re-exec failed",
+                        }
+                    ),
+                    flush=True,
+                )
                 os._exit(0)
 
     threading.Thread(target=_guard_loop, daemon=True).start()
 
-    # tiny warmup shape first: proves the pipeline end-to-end
-    guard["deadline"] = time.perf_counter() + per_size_budget
-    run_verify(pack(WARMUP_BATCH), f"warmup batch={WARMUP_BATCH}")
+    def arm_guard():
+        if guard_active:
+            guard["deadline"] = time.perf_counter() + per_size_budget
+
+    # tiny warmup shape first: proves the pipeline end-to-end before the
+    # big compiles. TPU only — on the CPU fallback every shape is a full
+    # extra pairing-program compile (~8 min at opt-0 on a 1-core host)
+    # and the single small fallback batch needs no pipeline proof.
+    if platform != "cpu":
+        arm_guard()
+        run_verify(pack(WARMUP_BATCH), f"warmup batch={WARMUP_BATCH}")
 
     best = None  # (sigs_per_sec, batch, degraded)
     sweep: dict[int, float] = {}
@@ -340,12 +386,12 @@ def main() -> None:
             actual = min(n_msgs, attempt) * (attempt // min(n_msgs, attempt))
             reset_ladder()
             packed = pack(attempt)
-            guard["deadline"] = time.perf_counter() + per_size_budget
+            arm_guard()
             run_verify(packed, f"main batch={actual}")
             kernel = state["kernel"]
             times = []
             for i in range(ITERS):
-                guard["deadline"] = time.perf_counter() + per_size_budget
+                arm_guard()
                 t = time.perf_counter()
                 kernel(*packed).block_until_ready()
                 times.append(time.perf_counter() - t)

@@ -60,6 +60,48 @@ def run_coro(coro):
     return box["result"]
 
 
+def _init_featureset(args) -> int:
+    """Apply --feature-set{,-enable,-disable} to the global feature
+    registry before the node builds (ref: app/app.go:136
+    featureset.Init). Returns nonzero on an unknown status or feature
+    name so a typo fails fast instead of silently running defaults."""
+    from charon_tpu.app import featureset
+
+    try:
+        status = featureset.Status[args.feature_set.upper()]
+    except KeyError:
+        print(
+            f"--feature-set {args.feature_set!r}: must be alpha, beta "
+            "or stable",
+            file=sys.stderr,
+        )
+        return 2
+
+    def parse_features(raw: str, flag: str):
+        out = []
+        for name in filter(None, raw.split(",")):
+            try:
+                out.append(featureset.Feature(name.strip()))
+            except ValueError:
+                known = ", ".join(f.value for f in featureset.Feature)
+                print(
+                    f"{flag} {name.strip()!r}: unknown feature "
+                    f"(known: {known})",
+                    file=sys.stderr,
+                )
+                return None
+        return out
+
+    enable = parse_features(args.feature_set_enable, "--feature-set-enable")
+    if enable is None:
+        return 2
+    disable = parse_features(args.feature_set_disable, "--feature-set-disable")
+    if disable is None:
+        return 2
+    featureset.init(status, enable=enable, disable=disable)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="charon-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -111,6 +153,23 @@ def build_parser() -> argparse.ArgumentParser:
         "--beacon-urls",
         default=_env_default("beacon-urls", ""),
         help="comma-separated beacon-node HTTP endpoints (failover order)",
+    )
+    # feature rollout control (ref: app/featureset Init bound via flags
+    # at app start, app/app.go:136)
+    runp.add_argument(
+        "--feature-set",
+        default=_env_default("feature-set", "stable"),
+        help="minimum feature rollout status to enable: alpha|beta|stable",
+    )
+    runp.add_argument(
+        "--feature-set-enable",
+        default=_env_default("feature-set-enable", ""),
+        help="comma-separated feature names to force-enable",
+    )
+    runp.add_argument(
+        "--feature-set-disable",
+        default=_env_default("feature-set-disable", ""),
+        help="comma-separated feature names to force-disable",
     )
 
     create = sub.add_parser(
@@ -369,6 +428,10 @@ def cmd_run(args) -> int:
             file=sys.stderr,
         )
         return 2
+
+    rc = _init_featureset(args)
+    if rc:
+        return rc
 
     peer_addrs = []
     if args.peers:

@@ -39,3 +39,12 @@ jax.config.update("jax_platforms", "cpu")
 from charon_tpu import jaxcache
 
 jaxcache.configure(jax, cpu=True)
+# READ-ONLY cache in the pytest process: serializing a fresh large
+# executable after this process has accumulated many programs segfaults
+# this image's jaxlib (CI.md "Known environment flake"; reproduced at
+# three different tests on 2026-07-31, always in put_executable_and_time
+# or the adjacent compile path). The isolated subprocess scripts
+# (tests/isolation_util.py) own all cache WRITES — fresh processes with
+# few programs never hit the trigger. An absurd min-compile-time keeps
+# reads enabled while suppressing writes.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1e9)

@@ -17,12 +17,15 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ISOLATED_HEADER = f"""
+ISOLATED_HEADER = """
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", {os.path.join(REPO, ".jax_cache")!r})
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# host-keyed CPU cache dir, same as conftest (charon_tpu/jaxcache.py) —
+# isolated subprocesses and in-process tests must share entries
+from charon_tpu import jaxcache as _jc
+
+_jc.configure(jax, cpu=True)
 """
 
 
@@ -34,7 +37,12 @@ def run_isolated(script: str, marker: str, timeout: float = 1500) -> None:
         capture_output=True,
         text=True,
         timeout=timeout,
-        env={**os.environ, "PYTHONPATH": REPO},
+        # tests/ on the path too: scripts share workload helpers with
+        # their in-process siblings (e.g. tests/meshwork.py)
+        env={
+            **os.environ,
+            "PYTHONPATH": REPO + os.pathsep + os.path.join(REPO, "tests"),
+        },
         cwd=REPO,
     )
     assert proc.returncode == 0, (

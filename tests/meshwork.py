@@ -1,0 +1,27 @@
+"""Shared mesh-test workload generator.
+
+Imported by tests/test_mesh.py (in-process cases) AND by its isolated
+subprocess scripts (tests/isolation_util.py puts this directory on the
+subprocess PYTHONPATH), so both always verify the same workload."""
+
+import random
+
+from charon_tpu.crypto import bls, h2c, shamir
+from charon_tpu.crypto.fields import R
+
+
+def make_workload(v: int, t: int = 3):
+    """v validators x t shares of deterministic t-of-(t+1) splits."""
+    pubshares, msgs, partials, group_pks, indices = [], [], [], [], []
+    for i in range(v):
+        det = random.Random(1000 + i)
+        sk = bls.keygen(bytes([i + 1]) * 32)
+        shares = shamir.split(sk, t + 1, t, rand=lambda: det.randrange(1, R))
+        msg = b"mesh-duty-%d" % i
+        idx = sorted(shares)[:t]
+        pubshares.append([bls.sk_to_pk(shares[j]) for j in idx])
+        partials.append([bls.sign(shares[j], msg) for j in idx])
+        msgs.append(h2c.hash_to_g2(msg))
+        group_pks.append(bls.sk_to_pk(sk))
+        indices.append(idx)
+    return pubshares, msgs, partials, group_pks, indices

@@ -388,3 +388,80 @@ def test_exit_fetch_via_publish_api(cluster, tmp_path):
             loop.call_soon_threadsafe(loop.stop)
             thread.join(timeout=10)
             loop.close()
+
+
+def test_dkg_rejects_unsupported_definition_version(tmp_path, capsys):
+    """The version gate fires at the CLI boundary: a dkg invocation
+    against an unknown definition revision fails up-front with the
+    supported list in the error (ref: dkg/dkg.go:108-116)."""
+    import json
+
+    from charon_tpu.cmd import cli
+
+    defn_path = tmp_path / "cluster-definition.json"
+    defn_path.write_text(
+        json.dumps(
+            {
+                "name": "future",
+                "uuid": "00000000-0000-0000-0000-0000000000ff",
+                "version": "ctpu/v9.9",
+                "num_validators": 1,
+                "threshold": 3,
+                "fork_version": "0x00000000",
+                "operators": [],
+            }
+        )
+    )
+    with pytest.raises(ValueError, match="unsupported cluster definition"):
+        cli.main(
+            [
+                "dkg",
+                "--definition-file",
+                str(defn_path),
+                "--data-dir",
+                str(tmp_path),
+                "--node-index",
+                "0",
+                "--peers",
+                "127.0.0.1:19000",
+            ]
+        )
+
+
+def test_run_feature_set_flags():
+    """--feature-set{,-enable,-disable} bind the global feature registry
+    before the node builds (ref: app/app.go:136 featureset.Init), and
+    typos fail fast."""
+    from types import SimpleNamespace
+
+    from charon_tpu.app import featureset
+    from charon_tpu.cmd.cli import _init_featureset
+
+    try:
+        args = SimpleNamespace(
+            feature_set="alpha",
+            feature_set_enable="",
+            feature_set_disable="eager_double_linear",
+        )
+        assert _init_featureset(args) == 0
+        # alpha rollout: the alpha-status flag is now on...
+        assert featureset.enabled(featureset.Feature.AGG_SIG_DB_V2)
+        # ...and the explicit disable wins over its stable status
+        assert not featureset.enabled(
+            featureset.Feature.EAGER_DOUBLE_LINEAR
+        )
+
+        bad = SimpleNamespace(
+            feature_set="experimental",
+            feature_set_enable="",
+            feature_set_disable="",
+        )
+        assert _init_featureset(bad) == 2
+        bad2 = SimpleNamespace(
+            feature_set="stable",
+            feature_set_enable="not_a_feature",
+            feature_set_disable="",
+        )
+        assert _init_featureset(bad2) == 2
+    finally:
+        featureset.init(featureset.Status.STABLE)

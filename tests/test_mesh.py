@@ -26,20 +26,11 @@ pytestmark = __import__("pytest").mark.slow
 T = 3
 
 
+from meshwork import make_workload
+
+
 def _workload(v: int):
-    pubshares, msgs, partials, group_pks, indices = [], [], [], [], []
-    for i in range(v):
-        det = random.Random(1000 + i)
-        sk = bls.keygen(bytes([i + 1]) * 32)
-        shares = shamir.split(sk, T + 1, T, rand=lambda: det.randrange(1, R))
-        msg = b"mesh-duty-%d" % i
-        idx = sorted(shares)[:T]
-        pubshares.append([bls.sk_to_pk(shares[j]) for j in idx])
-        partials.append([bls.sign(shares[j], msg) for j in idx])
-        msgs.append(h2c.hash_to_g2(msg))
-        group_pks.append(bls.sk_to_pk(sk))
-        indices.append(idx)
-    return pubshares, msgs, partials, group_pks, indices
+    return make_workload(v, T)
 
 
 @pytest.fixture(scope="module")
@@ -105,56 +96,80 @@ def test_all_invalid(plane):
     assert total == 0
 
 
-def test_step_rlc_all_valid_and_forged(plane):
+# The step_rlc pair runs in a fresh pinned subprocess: their fresh MSM
+# program compile lands ~18 tests into the slow tier, where this
+# image's jaxlib segfaults writing the executable to the persistent
+# cache (CI.md "Known environment flake"; reproduced 2/2 in-process,
+# 2026-07-31). One script covers both cases so the program compiles
+# once. Workload comes from the SAME shared generator the in-process
+# tests use (tests/meshwork.py).
+_STEP_RLC_SCRIPT_BODY = """
+import random
+
+import jax
+
+from charon_tpu.crypto import bls, shamir
+from charon_tpu.crypto.fields import R
+from charon_tpu.ops import curve as C
+from charon_tpu.parallel import SlotCryptoPlane, make_mesh
+from meshwork import make_workload
+
+T = 3
+plane = SlotCryptoPlane(make_mesh(jax.devices()), t=T)
+
+pubshares, msgs, partials, group_pks, indices = make_workload(8, T)
+
+# all-valid fast path: accepts, recombinations match the host oracle
+v = 8
+args = plane.pack_inputs(pubshares, msgs, partials, group_pks, indices)
+rand = plane.make_rand(v, rng=random.Random(42))
+group_sig, all_ok = plane.step_rlc(*args, rand)
+assert bool(all_ok)
+sigs = C.g2_unpack(plane.ctx, group_sig)[:v]
+for lane in range(v):
+    want = shamir.threshold_aggregate_g2(
+        dict(zip(indices[lane], partials[lane]))
+    )
+    assert sigs[lane] == want
+
+# forge one partial: signature over a different message flips the bool
+det = random.Random(1000 + 3)
+sk = bls.keygen(bytes([4]) * 32)
+shares = shamir.split(sk, T + 1, T, rand=lambda: det.randrange(1, R))
+partials_bad = [list(row) for row in partials]
+partials_bad[3][1] = bls.sign(shares[sorted(shares)[1]], b"forged")
+args_bad = plane.pack_inputs(
+    pubshares, msgs, partials_bad, group_pks, indices
+)
+_, all_ok_bad = plane.step_rlc(*args_bad, rand)
+assert not bool(all_ok_bad)
+
+# padding lanes (live=False) with INVALID content must not affect the
+# verdict (pack_inputs pads by duplicating lane 0 -> corrupt explicitly)
+v5 = 5
+ps, msg, sig, gpk, idx, live = plane.pack_inputs(
+    pubshares[:v5], msgs[:v5], partials[:v5], group_pks[:v5], indices[:v5]
+)
+sig = jax.tree_util.tree_map(lambda a: a.at[6].set(a[2]), sig)
+rand5 = plane.make_rand(v5, rng=random.Random(7))
+_, all_ok_pad = plane.step_rlc(ps, msg, sig, gpk, idx, live, rand5)
+assert bool(all_ok_pad)
+print("STEP-RLC-OK")
+"""
+
+
+def test_step_rlc_all_valid_forged_and_padding():
     """RLC fast path: all-valid slot accepts with ONE final exp per
-    shard; a forged partial flips the cluster-wide bool (attribution
-    then comes from the per-lane step)."""
-    v = 8
-    pubshares, msgs, partials, group_pks, indices = _workload(v)
-    args = plane.pack_inputs(pubshares, msgs, partials, group_pks, indices)
-    rand = plane.make_rand(v, rng=random.Random(42))
-    group_sig, all_ok = plane.step_rlc(*args, rand)
-    assert bool(all_ok)
-    # recombined signatures identical to the per-lane path's
-    from charon_tpu.ops import curve as C
+    shard and oracle-identical recombinations; a forged partial flips
+    the cluster-wide bool; corrupt padding lanes stay masked (body in a
+    fresh subprocess — see section comment)."""
+    from isolation_util import ISOLATED_HEADER, run_isolated
 
-    sigs = C.g2_unpack(plane.ctx, group_sig)[:v]
-    for lane in range(v):
-        want = shamir.threshold_aggregate_g2(
-            dict(zip(indices[lane], partials[lane]))
-        )
-        assert sigs[lane] == want
-
-    # forge one partial: signature over a different message
-    det = random.Random(1000 + 3)
-    sk = bls.keygen(bytes([4]) * 32)
-    shares = shamir.split(sk, T + 1, T, rand=lambda: det.randrange(1, R))
-    partials_bad = [list(row) for row in partials]
-    partials_bad[3][1] = bls.sign(shares[sorted(shares)[1]], b"forged")
-    args_bad = plane.pack_inputs(
-        pubshares, msgs, partials_bad, group_pks, indices
+    # 100 min: a cold MSM-program compile measured ~75 m on the loaded
+    # 1-core VM (CI.md round-5 stabilization notes)
+    run_isolated(
+        ISOLATED_HEADER + _STEP_RLC_SCRIPT_BODY, "STEP-RLC-OK", timeout=6000
     )
-    _, all_ok_bad = plane.step_rlc(*args_bad, rand)
-    assert not bool(all_ok_bad)
-
-
-def test_step_rlc_padding_lanes_ignored(plane):
-    """Padding lanes (live=False) must not affect the verdict even when
-    their content is INVALID — corrupt the padded region explicitly
-    (pack_inputs pads by duplicating lane 0, which would pass vacuously)."""
-    v = 5
-    pubshares, msgs, partials, group_pks, indices = _workload(v)
-    ps, msg, sig, gpk, idx, live = plane.pack_inputs(
-        pubshares, msgs, partials, group_pks, indices
-    )
-    # overwrite a padding lane's partials with another lane's (wrong
-    # message => invalid partials in the dead region)
-    import jax as _jax
-
-    sig = _jax.tree_util.tree_map(lambda a: a.at[6].set(a[2]), sig)
-    rand = plane.make_rand(v, rng=random.Random(7))
-    _, all_ok = plane.step_rlc(ps, msg, sig, gpk, idx, live, rand)
-    assert bool(all_ok)
 
 
 def test_2d_mesh_dcn_ici_layout():

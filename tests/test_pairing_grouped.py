@@ -2,10 +2,22 @@
 one Miller pair per distinct message + one aggregate pair, one final exp.
 Cross-checked against per-lane verification semantics."""
 
+import pytest
+
+# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
+pytestmark = pytest.mark.slow
+
+
+# The kernel cases run in ONE fresh subprocess (single compile, shared
+# program): their fresh grouped-kernel compile landed ~45 tests into the
+# slow tier, where this image's jaxlib segfaults inside
+# backend_compile_and_load (CI.md "Known environment flake"; reproduced
+# 2026-07-31 after the r4 kernel changes invalidated the old cache
+# entries for these shapes).
+_GROUPED_KERNEL_SCRIPT = """
 import random
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -15,15 +27,16 @@ from charon_tpu.ops import curve as C
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
 
-# Compile-heavy crypto tier: run with `pytest -m slow` (see CI.md).
-pytestmark = __import__("pytest").mark.slow
-
 M, K = 2, 3  # K=3 exercises the pad-to-pow2 path inside each group
+fp, fr = limb.default_fp_ctx(), limb.default_fr_ctx()
+kernel = jax.jit(
+    lambda pk, msg, sig, r: DP.batched_verify_grouped_rlc(
+        fp, fr, pk, msg, sig, r
+    )
+)
 
 
-def _workload(forge=None, wrong_group=None):
-    """[M, K] lanes: group m all sign message m."""
-    ctx = limb.default_fp_ctx()
+def workload(forge=None, wrong_group=None):
     msgs_raw = [b"group-msg-%d" % m for m in range(M)]
     msg_pts = [h2c.hash_to_g2(x) for x in msgs_raw]
     pks, sigs = [], []
@@ -37,47 +50,54 @@ def _workload(forge=None, wrong_group=None):
             if wrong_group == (m, j):
                 signed = msgs_raw[(m + 1) % M]
             sigs.append(bls.sign(sk, signed))
-    pk = C.g1_pack(ctx, pks)
+    pk = C.g1_pack(fp, pks)
     pk = jax.tree_util.tree_map(lambda a: a.reshape(M, K, -1), pk)
-    sig = C.g2_pack(ctx, sigs)
+    sig = C.g2_pack(fp, sigs)
     sig = jax.tree_util.tree_map(lambda a: a.reshape(M, K, -1), sig)
-    msg = C.g2_pack(ctx, msg_pts)
-    return ctx, pk, msg, sig
+    msg = C.g2_pack(fp, msg_pts)
+    return pk, msg, sig
 
 
-def _rand(fr_ctx, seed=11):
+def rand(seed=11):
     rng = random.Random(seed)
-    flat = limb.ctx_pack(
-        fr_ctx, [rng.randrange(1, 1 << 64) for _ in range(M * K)]
-    )
+    flat = limb.ctx_pack(fr, [rng.randrange(1, 1 << 64) for _ in range(M * K)])
     return jnp.asarray(np.asarray(flat).reshape(M, K, -1))
 
 
-@pytest.fixture(scope="module")
-def kernel():
-    fp, fr = limb.default_fp_ctx(), limb.default_fr_ctx()
-    return jax.jit(
-        lambda pk, msg, sig, r: DP.batched_verify_grouped_rlc(
-            fp, fr, pk, msg, sig, r
-        )
+# accepts an all-valid grouped batch
+pk, msg, sig = workload()
+assert bool(kernel(pk, msg, sig, rand()))
+
+# rejects a forged lane
+pk, msg, sig = workload(forge=(1, 2))
+assert not bool(kernel(pk, msg, sig, rand()))
+
+# a signature valid for ANOTHER group's message must not pass in its own
+# group (the bucket binds lanes to their group's message)
+pk, msg, sig = workload(wrong_group=(0, 1))
+assert not bool(kernel(pk, msg, sig, rand()))
+
+# zero exponents (padding) neutralize a lane even if its content is
+# garbage
+pk, msg, sig = workload(forge=(0, 0))
+r = np.array(rand(), copy=True)
+r[0, 0] = 0
+assert bool(kernel(pk, msg, sig, jnp.asarray(r)))
+print("GROUPED-KERNEL-OK")
+"""
+
+
+def test_grouped_kernel_accept_reject_and_padding():
+    """Grouped-RLC kernel semantics: accepts all-valid, rejects a forged
+    lane and a cross-group signature, zero-exponent lanes stay neutral
+    (body in a fresh subprocess — see section comment)."""
+    from isolation_util import ISOLATED_HEADER, run_isolated
+
+    run_isolated(
+        ISOLATED_HEADER + _GROUPED_KERNEL_SCRIPT,
+        "GROUPED-KERNEL-OK",
+        timeout=3000,
     )
-
-
-def test_grouped_accepts_valid(kernel):
-    ctx, pk, msg, sig = _workload()
-    assert bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
-
-
-def test_grouped_rejects_forged_lane(kernel):
-    ctx, pk, msg, sig = _workload(forge=(1, 2))
-    assert not bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
-
-
-def test_grouped_rejects_wrong_group_signature(kernel):
-    """A signature valid for ANOTHER group's message must not pass in its
-    own group (the bucket binds lanes to their group's message)."""
-    ctx, pk, msg, sig = _workload(wrong_group=(0, 1))
-    assert not bool(kernel(pk, msg, sig, _rand(limb.default_fr_ctx())))
 
 
 # Runs in a FRESH subprocess: compiling the m=4 engine shape after this
@@ -115,12 +135,3 @@ def test_engine_grouped_pads_m3_to_4():
     # the padded grouped kernel (now including the Pippenger MSM stage)
     # and the per-lane attribution kernel for the invalid-batch case
     run_isolated(ISOLATED_HEADER + _PAD_PATH_SCRIPT, "PAD-PATH-OK", timeout=2700)
-
-
-def test_grouped_zero_exponent_lanes_neutral(kernel):
-    """Zero exponents (padding) neutralize a lane even if its content is
-    garbage — swap in a forged sig AND zero that lane's exponent."""
-    ctx, pk, msg, sig = _workload(forge=(0, 0))
-    rand = np.array(_rand(limb.default_fr_ctx()), copy=True)
-    rand[0, 0] = 0
-    assert bool(kernel(pk, msg, sig, jnp.asarray(rand)))
