@@ -318,9 +318,9 @@ def main() -> None:
                     print(guard["banked"], flush=True)
                     os._exit(0)
                 # nothing banked: the device wedged before any batch
-                # completed. Re-exec for a fresh claim while the global
-                # claim budget lasts (same ladder as a pre-claim wedge);
-                # only past the budget emit the error line.
+                # completed. Follow the full claim ladder — a fresh TPU
+                # claim inside the budget, the CPU-pinned re-exec past
+                # it; the error line only if re-exec itself fails.
                 from bench_common import claim_retry_env
 
                 try:

@@ -39,12 +39,13 @@ jax.config.update("jax_platforms", "cpu")
 from charon_tpu import jaxcache
 
 jaxcache.configure(jax, cpu=True)
-# READ-ONLY cache in the pytest process: serializing a fresh large
-# executable after this process has accumulated many programs segfaults
-# this image's jaxlib (CI.md "Known environment flake"; reproduced at
-# three different tests on 2026-07-31, always in put_executable_and_time
-# or the adjacent compile path). The isolated subprocess scripts
-# (tests/isolation_util.py) own all cache WRITES — fresh processes with
-# few programs never hit the trigger. An absurd min-compile-time keeps
-# reads enabled while suppressing writes.
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1e9)
+# NOTE on the persistent-cache segfault (CI.md "Known environment
+# flake"): a fresh LARGE-program compile landing late in this
+# program-heavy process can segfault jaxlib — in the cache write OR in
+# backend_compile_and_load itself (both observed 2026-07-31/08-01), so
+# suppressing writes here would not help and would leave non-isolated
+# files permanently cold. The containment is structural instead: every
+# known compile-heavy test body runs in a fresh subprocess
+# (tests/isolation_util.py); if a future kernel change makes another
+# in-process file's big program cold and it starts crashing the tier,
+# isolate that file the same way.

@@ -390,7 +390,7 @@ def test_exit_fetch_via_publish_api(cluster, tmp_path):
             loop.close()
 
 
-def test_dkg_rejects_unsupported_definition_version(tmp_path, capsys):
+def test_dkg_rejects_unsupported_definition_version(tmp_path):
     """The version gate fires at the CLI boundary: a dkg invocation
     against an unknown definition revision fails up-front with the
     supported list in the error (ref: dkg/dkg.go:108-116)."""

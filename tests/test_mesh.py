@@ -11,12 +11,11 @@ recombine) — each is a fresh pairing-program compile in this tier."""
 
 import random
 
-import numpy as np
 import pytest
 
 import jax
 
-from charon_tpu.crypto import bls, h2c, shamir
+from charon_tpu.crypto import bls, shamir
 from charon_tpu.crypto.fields import R
 from charon_tpu.parallel import SlotCryptoPlane, make_mesh
 
