@@ -54,14 +54,29 @@ class MultiClient:
     calls (attestation data at ⅓ slot) ride the fastest healthy node."""
 
     LATENCY_WINDOW = 64
+    # hedge dispatch: when the best client has not answered within
+    # HEDGE_FACTOR x its rolling-median latency (floored at HEDGE_MIN,
+    # so a cold cache cannot hedge instantly), the runner-up is raced
+    # and the first success wins — a stalled primary BN then costs one
+    # median-latency wait, not a full `timeout`
+    HEDGE_FACTOR = 2.0
+    HEDGE_MIN = 0.05
 
-    def __init__(self, clients: Sequence[Any], timeout: float = 5.0) -> None:
+    def __init__(
+        self,
+        clients: Sequence[Any],
+        timeout: float = 5.0,
+        hedge: bool = True,
+    ) -> None:
         from collections import deque
 
         if not clients:
             raise ValueError("need at least one beacon client")
         self.clients = list(clients)
         self.timeout = timeout
+        self.hedge = hedge and len(self.clients) >= 2
+        self.hedged_total = 0  # hedges dispatched
+        self.hedge_wins = 0  # hedges that answered first
         self.latencies: dict[str, list[float]] = defaultdict(list)
         self.errors: dict[int, int] = defaultdict(int)
         # rolling per-client latency window for the selection heuristic
@@ -87,24 +102,104 @@ class MultiClient:
     def best_idx(self) -> int:
         return self.best_order()[0]
 
+    async def _call_one(self, i: int, name: str, args, kwargs):
+        client = self.clients[i]
+        t0 = time.monotonic()
+        result = await asyncio.wait_for(
+            getattr(client, name)(*args, **kwargs), self.timeout
+        )
+        elapsed = time.monotonic() - t0
+        self.latencies[name].append(elapsed)
+        self.client_latency[i].append(elapsed)
+        self.errors[i] = max(0, self.errors[i] - 1)
+        return result
+
+    def _hedge_delay(self, i: int) -> float | None:
+        """Seconds to wait before racing the runner-up, or None when the
+        primary has no latency history yet (an untried client gets one
+        un-hedged sample first — hedging on zero data would double every
+        call's load)."""
+        window = self.client_latency[i]
+        if not self.hedge or not window:
+            return None
+        return max(self._median_latency(i) * self.HEDGE_FACTOR, self.HEDGE_MIN)
+
+    async def _hedged_pair(self, first: int, second: int, name: str, args, kwargs):
+        """Race primary vs runner-up: runner-up starts only after the
+        hedge delay elapses with the primary still pending (ref:
+        multi.go's best-client race, plus the classic tail-latency hedge).
+        Returns (ok, result, errs, failed) — ok is the explicit success
+        flag because most beacon methods legitimately return None, and
+        `failed` are the indices that ran and failed."""
+        errs: list[str] = []
+        failed: set[int] = set()
+        race: set = set()
+        primary = asyncio.ensure_future(
+            self._call_one(first, name, args, kwargs)
+        )
+        race.add(primary)
+        try:
+            done, _ = await asyncio.wait(
+                {primary}, timeout=self._hedge_delay(first)
+            )
+            if done:
+                try:
+                    return True, primary.result(), errs, failed
+                except Exception as e:  # noqa: BLE001 — fails over
+                    self.errors[first] += 1
+                    errs.append(f"client{first}: {e!r}")
+                    return False, None, errs, {first}
+            self.hedged_total += 1
+            hedge = asyncio.ensure_future(
+                self._call_one(second, name, args, kwargs)
+            )
+            race.add(hedge)
+            pending = set(race)
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for task in done:
+                    race.discard(task)
+                    exc = task.exception()
+                    if exc is None:
+                        if task is hedge:
+                            self.hedge_wins += 1
+                        return True, task.result(), errs, failed
+                    idx = first if task is primary else second
+                    self.errors[idx] += 1
+                    failed.add(idx)
+                    errs.append(f"client{idx}: {exc!r}")
+            return False, None, errs, failed
+        finally:
+            # cancel losers AND in-flight calls on external cancellation
+            # (a duty-deadline cancel mid-hedge must not leave a submit
+            # landing at the BN after the tracker reported the miss)
+            for task in race:
+                if not task.done():
+                    task.cancel()
+
     def __getattr__(self, name: str):
         if name not in _METHODS:
             raise AttributeError(name)
 
         async def call(*args, **kwargs):
-            errs = []
-            for i in self.best_order():
-                client = self.clients[i]
-                t0 = time.monotonic()
-                try:
-                    result = await asyncio.wait_for(
-                        getattr(client, name)(*args, **kwargs), self.timeout
-                    )
-                    elapsed = time.monotonic() - t0
-                    self.latencies[name].append(elapsed)
-                    self.client_latency[i].append(elapsed)
-                    self.errors[i] = max(0, self.errors[i] - 1)
+            errs: list[str] = []
+            tried: set[int] = set()
+            order = self.best_order()
+            # best two ride the hedge; the race resolves stalls, the
+            # sequential tail below resolves hard failures
+            if len(order) >= 2 and self._hedge_delay(order[0]) is not None:
+                ok, result, errs, tried = await self._hedged_pair(
+                    order[0], order[1], name, args, kwargs
+                )
+                if ok:
                     return result
+            for i in order:
+                if i in tried:
+                    continue
+                try:
+                    return await self._call_one(i, name, args, kwargs)
                 except Exception as e:  # noqa: BLE001 — any failure fails over
                     self.errors[i] += 1
                     errs.append(f"client{i}: {e!r}")

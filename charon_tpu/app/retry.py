@@ -16,7 +16,7 @@ from typing import Awaitable, Callable
 BACKOFF_SECS = 1.0  # ref: retry/retry.go constant backoff
 
 
-def _retryable() -> tuple:
+def retryable_errors() -> tuple:
     # lazy: avoid a hard import edge at module load; AllClientsFailedError
     # (every configured BN failed) is the framework's own transient
     # network failure and MUST be retried (ref: retry.go classifies
@@ -32,6 +32,8 @@ def _retryable() -> tuple:
     )
 
 
+_retryable = retryable_errors  # historical internal name
+
 RETRYABLE = (ConnectionError, TimeoutError, asyncio.TimeoutError, OSError)
 
 
@@ -45,14 +47,23 @@ class Retryer:
         self._tasks: set[asyncio.Task] = set()
 
     async def retry(self, name: str, duty, fn, *args) -> None:
+        """Deadline-bounded, not attempt-bounded: each attempt runs
+        under wait_for(remaining) so a HUNG call cannot overshoot the
+        duty deadline either — the timeout classifies as transient and
+        the loop then stops at the deadline check. Cancellation (duty
+        torn down / process stopping) propagates immediately: it is a
+        BaseException and never swallowed as a retry."""
         deadline = self.deadline_of(duty)
         attempt = 0
         while True:
             attempt += 1
+            remaining = deadline - self.now()
+            if remaining <= 0:
+                return  # deadline exceeded; tracker reports the miss
             try:
-                await fn(duty, *args)
+                await asyncio.wait_for(fn(duty, *args), timeout=remaining)
                 return
-            except _retryable():
+            except retryable_errors():
                 if self.now() + self.backoff >= deadline:
                     return  # deadline exceeded; tracker reports the miss
                 await asyncio.sleep(self.backoff)

@@ -76,6 +76,10 @@ class Config:
     # OTLP/HTTP collector for workflow spans (ref: --jaeger-address,
     # app/app.go:1014-1027 wireTracing); "" disables export
     tracing_endpoint: str = ""
+    # seeded fault-injection spec ("seed=42,drop=0.1,bn_error=0.2"; see
+    # app/faultinject + testutil/chaos). "" keeps the plane inert: no
+    # wrapper objects are constructed on the un-instrumented path.
+    fault_injection: str = ""
 
 
 @dataclass
@@ -98,6 +102,29 @@ class Node:
     inclusion: InclusionChecker | None = None
 
 
+def _resilient_ladder(primary):
+    """Wrap the chosen tbls backend in the degradation ladder: primary
+    -> native C++ (when available and not already primary) -> pure-
+    python spec. A backend ERROR (wedged device, native crash) then
+    costs latency on the lower rung instead of the duty; verdicts
+    (TblsError) pass through untouched. The fault-injection plane's
+    crypto faults wrap the primary so chaos runs exercise the ladder."""
+    from charon_tpu.app import faultinject
+    from charon_tpu.tbls.python_impl import PythonImpl
+    from charon_tpu.tbls.resilient import ResilientImpl
+
+    rungs = [faultinject.maybe_wrap_tbls(primary)]
+    if type(primary).__name__ != "NativeImpl":
+        try:
+            from charon_tpu.tbls.native_impl import NativeImpl
+
+            rungs.append(NativeImpl())
+        except Exception:  # noqa: BLE001 — native rung is optional
+            pass
+    rungs.append(PythonImpl())
+    return ResilientImpl(rungs)
+
+
 async def build_node(config: Config) -> Node:
     data_dir = Path(config.data_dir)
     # manifest mutation-DAG takes precedence over the plain lock
@@ -109,11 +136,25 @@ async def build_node(config: Config) -> Node:
     t = lock.definition.threshold
     share_idx = config.node_index + 1
 
+    # fault-injection plane (inert unless the flag/env carries a spec):
+    # installed FIRST so every boundary below can be wrapped
+    from charon_tpu.app import faultinject
+
+    if config.fault_injection:
+        faultinject.install(config.fault_injection)
+        log.warn(
+            "fault injection ACTIVE",
+            topic="app",
+            spec=config.fault_injection,
+        )
+    else:
+        faultinject.init_from_env()
+
     crypto_plane = None
     if config.use_tpu_tbls:
         from charon_tpu.tbls.tpu_impl import TPUImpl
 
-        tbls.set_implementation(TPUImpl())
+        tbls.set_implementation(_resilient_ladder(TPUImpl()))
         if config.crypto_plane != "off":
             import jax
 
@@ -145,7 +186,7 @@ async def build_node(config: Config) -> Node:
         try:
             from charon_tpu.tbls.native_impl import NativeImpl
 
-            tbls.set_implementation(NativeImpl())
+            tbls.set_implementation(_resilient_ladder(NativeImpl()))
         except Exception as e:
             log.warn(
                 "native tbls backend unavailable; pure-python crypto",
@@ -255,6 +296,9 @@ async def build_node(config: Config) -> Node:
         beacon = SyntheticProposerClient(
             beacon, slots_per_epoch=config.slots_per_epoch
         )
+    # outermost so every component sees the injected faults (inert
+    # no-op returning `beacon` unchanged unless the plane is active)
+    beacon = faultinject.maybe_wrap_beacon(beacon)
 
     # -- lifecycle ---------------------------------------------------------
     life = LifecycleManager()
@@ -292,6 +336,8 @@ async def build_node(config: Config) -> Node:
             relay=relay_client,
         )
         await p2p_node.start()
+        # frame-level faults on the live mesh (inert no-op by default)
+        faultinject.maybe_wrap_p2p_node(p2p_node)
         qbft_net = TcpQbftNet(p2p_node)
         parsig_transport = TcpParSigTransport(p2p_node)
         life.register_stop(Order.P2P, "p2p", p2p_node.stop)

@@ -171,6 +171,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=_env_default("feature-set-disable", ""),
         help="comma-separated feature names to force-disable",
     )
+    # seeded fault injection (ISSUE 2): inert unless a spec is given —
+    # the env var CHARON_TPU_FAULT_INJECTION is the non-CLI equivalent
+    runp.add_argument(
+        "--fault-injection",
+        default=_env_default("fault-injection", ""),
+        help="seeded fault-injection spec, e.g. 'seed=42,drop=0.1,"
+        "bn_error=0.2' (keys: testutil.chaos.ChaosConfig); empty = off",
+    )
 
     create = sub.add_parser(
         "create-cluster",
@@ -433,6 +441,17 @@ def cmd_run(args) -> int:
     if rc:
         return rc
 
+    if args.fault_injection:
+        # fail fast: a typo'd fault spec silently injecting nothing
+        # would void the whole chaos run
+        try:
+            from charon_tpu.testutil.chaos import config_from_spec
+
+            config_from_spec(args.fault_injection)
+        except ValueError as e:
+            print(f"--fault-injection: {e}", file=sys.stderr)
+            return 2
+
     peer_addrs = []
     if args.peers:
         for part in args.peers.split(","):
@@ -456,6 +475,7 @@ def cmd_run(args) -> int:
         crypto_plane=args.crypto_plane,
         tracing_endpoint=args.tracing_endpoint,
         relay_addr=args.relay,
+        fault_injection=args.fault_injection,
     )
     run_coro(run(config))
     return 0

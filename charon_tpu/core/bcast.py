@@ -23,6 +23,7 @@ class Broadcaster:
         self.broadcast_total: dict[DutyType, int] = {}
         self.broadcast_delay: list[tuple[Duty, float]] = []
         self.recast_errors = 0  # feeds app/health (ref: recast.go metric)
+        self.retried_total = 0  # deadline-aware submit retries
         self._registrations: dict[Duty, dict] = {}
         self._subs: list = []  # post-broadcast hooks (inclusion checker)
 
@@ -32,36 +33,86 @@ class Broadcaster:
         app/app.go:746-780)."""
         self._subs.append(sub)
 
+    async def _submit(self, duty: Duty, fn, *args) -> None:
+        """Submit with deadline-aware retry: a transient BN failure
+        (connection reset, timeout, every-endpoint-down) retries with
+        jittered exponential backoff (app/expbackoff FAST schedule)
+        until the duty's deadline — a flapping BN a few hundred ms
+        before recovery must not turn an aggregated signature into a
+        missed duty. Without a clock (bare unit-test wiring) the first
+        error propagates unchanged."""
+        import asyncio
+
+        from charon_tpu.app.expbackoff import FAST_CONFIG, backoff_delay
+        from charon_tpu.app.retry import retryable_errors
+
+        attempt = 0
+        while True:
+            try:
+                return await fn(*args)
+            except retryable_errors() as e:
+                if self.clock is None:
+                    raise
+                delay = backoff_delay(FAST_CONFIG, attempt)
+                if time.time() + delay >= self.clock.duty_deadline(duty):
+                    raise
+                if attempt == 0:
+                    from charon_tpu.app import log
+
+                    log.warn(
+                        "broadcast failed; retrying until duty deadline",
+                        topic="bcast",
+                        duty=str(duty),
+                        err=f"{type(e).__name__}: {e}",
+                    )
+                self.retried_total += 1
+                attempt += 1
+                await asyncio.sleep(delay)
+
     async def broadcast(self, duty: Duty, data_set: dict[PubKey, SignedData]) -> None:
         """ref: core/bcast/bcast.go:42 Broadcast type-switch."""
         for pubkey, signed in data_set.items():
             if duty.type == DutyType.ATTESTER:
-                await self.beacon.submit_attestation(self._with_sig(signed))
+                await self._submit(
+                    duty, self.beacon.submit_attestation, self._with_sig(signed)
+                )
             elif duty.type == DutyType.PROPOSER:
-                await self.beacon.submit_proposal(signed.payload, signed.signature)
+                await self._submit(
+                    duty, self.beacon.submit_proposal, signed.payload, signed.signature
+                )
             elif duty.type == DutyType.RANDAO:
                 pass  # randao is an input to proposals, never broadcast
             elif duty.type == DutyType.BUILDER_REGISTRATION:
-                await self.beacon.submit_registration(signed.payload, signed.signature)
+                await self._submit(
+                    duty, self.beacon.submit_registration, signed.payload, signed.signature
+                )
                 # merge per pubkey — separate submissions share the duty
                 # key (slot 0), and the recaster needs all of them
                 merged = dict(self._registrations.get(duty, {}))
                 merged.update(data_set)
                 self._registrations[duty] = merged
             elif duty.type == DutyType.EXIT:
-                await self.beacon.submit_exit(signed.payload, signed.signature)
+                await self._submit(
+                    duty, self.beacon.submit_exit, signed.payload, signed.signature
+                )
             elif duty.type == DutyType.AGGREGATOR:
-                await self.beacon.submit_aggregate(signed.payload, signed.signature)
+                await self._submit(
+                    duty, self.beacon.submit_aggregate, signed.payload, signed.signature
+                )
             elif duty.type == DutyType.SYNC_MESSAGE:
                 from dataclasses import replace as _replace
 
-                await self.beacon.submit_sync_message(
+                await self._submit(
+                    duty,
+                    self.beacon.submit_sync_message,
                     _replace(signed.payload, signature=signed.signature)
                     if hasattr(signed.payload, "signature")
-                    else signed.payload
+                    else signed.payload,
                 )
             elif duty.type == DutyType.SYNC_CONTRIBUTION:
-                await self.beacon.submit_contribution(signed.payload, signed.signature)
+                await self._submit(
+                    duty, self.beacon.submit_contribution, signed.payload, signed.signature
+                )
             elif duty.type in (
                 DutyType.PREPARE_AGGREGATOR,
                 DutyType.PREPARE_SYNC_CONTRIBUTION,
@@ -77,7 +128,21 @@ class Broadcaster:
                 (duty, time.time() - self.clock.slot_start(duty.slot))
             )
         for sub in self._subs:
-            await sub(duty, data_set)
+            # post-broadcast observers (inclusion checker) are
+            # best-effort: the duty IS broadcast by now, and an observer
+            # bug must not re-report it failed — nor cascade the error
+            # back through the aggregation chain that invoked us
+            try:
+                await sub(duty, data_set)
+            except Exception as e:  # noqa: BLE001
+                from charon_tpu.app import log
+
+                log.warn(
+                    "post-broadcast subscriber failed",
+                    topic="bcast",
+                    duty=str(duty),
+                    err=f"{type(e).__name__}: {e}",
+                )
 
     def _with_sig(self, signed: SignedData):
         """Attestations carry their signature inline."""

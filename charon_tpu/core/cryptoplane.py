@@ -118,6 +118,7 @@ class SlotCoalescer:
         self.flushes = 0
         self.coalesced_flushes = 0  # flushes that merged >= 2 jobs
         self.lanes_flushed = 0
+        self.host_fallback_flushes = 0  # served by the python-spec rung
         # called after each flush with (jobs, lanes) — thread-safe
         # counters only (runs on the device worker thread)
         self.metrics_hook = metrics_hook
@@ -230,12 +231,32 @@ class SlotCoalescer:
         except Exception as e:  # noqa: BLE001 — degrade, else fail waiters
             retried = await self._degrade_and_retry(vq, rq, e)
             if retried is None:
-                for job in [*vq, *rq]:
-                    if not job.fut.done():
-                        job.fut.set_exception(
-                            TblsError(f"crypto plane flush failed: {e}")
-                        )
-                return
+                # last rung: the pure-python spec oracle. Orders of
+                # magnitude slower than the device, but a wedged
+                # accelerator must cost latency, not the duty — the
+                # signing plane stays live on the degraded backend
+                # (ISSUE: degrade TPU -> native -> python-spec).
+                try:
+                    retried = await loop.run_in_executor(
+                        self._executor, self._run_host_oracle, vq, rq
+                    )
+                    self.host_fallback_flushes += 1
+                    from charon_tpu.app import log
+
+                    log.warn(
+                        "crypto plane flush served by python-spec "
+                        "host fallback",
+                        topic="cryptoplane",
+                        rung="host-oracle",
+                        err=f"{type(e).__name__}: {str(e)[:160]}",
+                    )
+                except Exception:  # noqa: BLE001 — rungs exhausted
+                    for job in [*vq, *rq]:
+                        if not job.fut.done():
+                            job.fut.set_exception(
+                                TblsError(f"crypto plane flush failed: {e}")
+                            )
+                    return
             vres, rres = retried
         for job, res in zip(vq, vres):
             if not job.fut.done():
@@ -351,6 +372,65 @@ class SlotCoalescer:
                         oks.append(next(it_ok))
                 rres.append((sigs_pts, oks))
             lanes += len(msg)
+        self.lanes_flushed += lanes
+        self.flushes += 1
+        if len(vq) + len(rq) >= 2:
+            self.coalesced_flushes += 1
+        if self.metrics_hook is not None:
+            self.metrics_hook(len(vq) + len(rq), lanes)
+        return vres, rres
+
+    # -- python-spec host fallback (worker thread) -------------------------
+
+    @staticmethod
+    def _oracle_verify_lane(pk_pt, msg_pt, sig_pt) -> bool:
+        from charon_tpu.crypto.bls import G1_GEN, g1_neg
+        from charon_tpu.crypto.pairing_fast import (
+            is_gt_one,
+            multi_pairing_fast,
+        )
+
+        return is_gt_one(
+            multi_pairing_fast([(sig_pt, g1_neg(G1_GEN)), (msg_pt, pk_pt)])
+        )
+
+    def _run_host_oracle(self, vq: list[_VerifyJob], rq: list[_RecombineJob]):
+        """Serve the SAME batch shape as _run_device on the pure-python
+        spec backend (crypto/bls + crypto/shamir): per-lane pairing
+        verify and Lagrange recombination on decoded points. No device,
+        no jitted programs — the rung below every accelerator failure."""
+        from charon_tpu.crypto import shamir
+
+        lanes = 0
+        vres: list[list[bool]] = []
+        for job in vq:
+            out = []
+            for lane in job.lanes:
+                if lane is None:
+                    out.append(False)
+                    continue
+                out.append(self._oracle_verify_lane(*lane))
+                lanes += 1
+            vres.append(out)
+        rres: list[tuple[list, list[bool]]] = []
+        for job in rq:
+            sigs_pts: list = []
+            oks: list[bool] = []
+            for i, pf in enumerate(job.prefail):
+                if pf:
+                    sigs_pts.append(None)
+                    oks.append(False)
+                    continue
+                group_sig = shamir.threshold_aggregate_g2(
+                    dict(zip(job.indices[i], job.partials[i]))
+                )
+                ok = self._oracle_verify_lane(
+                    job.group_pks[i], job.msgs[i], group_sig
+                )
+                sigs_pts.append(group_sig)
+                oks.append(ok)
+                lanes += 1
+            rres.append((sigs_pts, oks))
         self.lanes_flushed += lanes
         self.flushes += 1
         if len(vq) + len(rq) >= 2:

@@ -54,10 +54,26 @@ class ParSigDB:
         self, duty: Duty, signed_set: dict[PubKey, ParSignedData]
     ) -> None:
         """Store our own partial signatures and fan them out to the peers
-        via the subscribed exchange (ref: memory.go:57-77)."""
-        for sub in self._internal_subs:
-            await sub(duty, signed_set)
+        via the subscribed exchange (ref: memory.go:57-77).
+
+        Local store FIRST: the node's own partial must survive a failing
+        peer exchange (it is one of the t the cluster needs), so the
+        store cannot sit downstream of the network call. Exchange
+        failures are isolated per sub — they are attributed at their own
+        wire() edge and must not erase the completed local store."""
         await self.store_external(duty, signed_set)
+        for sub in self._internal_subs:
+            try:
+                await sub(duty, signed_set)
+            except Exception as e:  # noqa: BLE001 — exchange is best-effort
+                from charon_tpu.app import log
+
+                log.warn(
+                    "partial-signature exchange failed",
+                    topic="parsigdb",
+                    duty=str(duty),
+                    err=f"{type(e).__name__}: {e}",
+                )
 
     async def store_external(
         self, duty: Duty, signed_set: dict[PubKey, ParSignedData]
@@ -71,7 +87,21 @@ class ParSigDB:
                 ready[pubkey] = batch
         if ready:
             for sub in self._threshold_subs:
-                await sub(duty, ready)
+                # isolate: this store may be running inside a PEER's
+                # send chain (mem transport); a local aggregation
+                # failure is attributed at its own wire() edge and must
+                # not cascade back into the sender's pipeline
+                try:
+                    await sub(duty, ready)
+                except Exception as e:  # noqa: BLE001
+                    from charon_tpu.app import log
+
+                    log.warn(
+                        "threshold subscriber failed",
+                        topic="parsigdb",
+                        duty=str(duty),
+                        err=f"{type(e).__name__}: {e}",
+                    )
 
     def _put(
         self, duty: Duty, pubkey: PubKey, psig: ParSignedData

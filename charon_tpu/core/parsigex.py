@@ -23,6 +23,15 @@ from charon_tpu.eth2util.signing import ForkInfo
 ExSub = Callable[[Duty, dict[PubKey, ParSignedData]], Awaitable[None]]
 
 
+def _transient() -> tuple:
+    """Network-ish error classes worth a deadline-bounded resend — the
+    ONE classification, owned by app/retry (lazy: core must not import
+    app at module load)."""
+    from charon_tpu.app.retry import RETRYABLE
+
+    return RETRYABLE
+
+
 class DutyGater:
     """Rejects expired or far-future duties before any crypto runs
     (ref: core/parsigex/parsigex.go:81 wires core.NewDutyGater,
@@ -109,7 +118,12 @@ class Eth2Verifier:
 
 
 class MemTransport:
-    """Loopback wiring of n ParSigEx components (in-process simnet)."""
+    """Loopback wiring of n ParSigEx components (in-process simnet).
+
+    Deliveries are isolated per destination (ref: p2p sender failures
+    are per-peer): one receiver's downstream failure must neither skip
+    the remaining peers nor cascade back into the sender's own duty
+    pipeline."""
 
     def __init__(self) -> None:
         self.nodes: list["ParSigEx"] = []
@@ -119,32 +133,96 @@ class MemTransport:
 
     async def send(self, from_idx: int, duty: Duty, signed_set) -> None:
         for node in self.nodes:
-            if node.share_idx != from_idx:
+            if node.share_idx == from_idx:
+                continue
+            try:
                 await node.receive(duty, signed_set)
+            except Exception as e:  # noqa: BLE001 — per-peer isolation
+                from charon_tpu.app import log
+
+                log.warn(
+                    "peer receive failed",
+                    topic="parsigex",
+                    peer=node.share_idx,
+                    duty=str(duty),
+                    err=f"{type(e).__name__}: {e}",
+                )
 
 
 class ParSigEx:
+    """clock (optional SlotClock): enables deadline-aware resend — a
+    transient transport failure re-sends with jittered backoff until the
+    duty's deadline instead of giving up after one attempt (reusing
+    app/expbackoff; ref: p2p sender retries under the duty context)."""
+
     def __init__(
         self,
         share_idx: int,
         transport: MemTransport,
         verifier: Eth2Verifier | None = None,
         gater: Callable[[Duty], bool] | None = None,
+        clock: SlotClock | None = None,
     ) -> None:
         self.share_idx = share_idx
         self.transport = transport
         self.verifier = verifier
         self.gater = gater
+        self.clock = clock
         self.dropped_stale = 0  # metric: sets gated before crypto
+        self.resend_total = 0  # metric: deadline-retry resends
         self._subs: list[ExSub] = []
+        self._retry_tasks: set = set()
         transport.attach(self)
 
     def subscribe(self, sub: ExSub) -> None:
         self._subs.append(sub)
 
     async def broadcast(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> None:
-        """Send our partials to all peers (ref: parsigex.go:112)."""
-        await self.transport.send(self.share_idx, duty, signed_set)
+        """Send our partials to all peers (ref: parsigex.go:112).
+
+        First attempt inline; on a transient transport failure the send
+        moves to a background deadline-bounded retry (fire-and-forget,
+        like the reference's SendAsync) so the VC's submission path is
+        never held hostage by a flapping peer link. Receivers dedup by
+        share index, so a resend that partially succeeded is safe."""
+        try:
+            await self.transport.send(self.share_idx, duty, signed_set)
+        except _transient() as e:
+            if self.clock is None:
+                raise
+            import asyncio
+
+            from charon_tpu.app import log
+
+            log.warn(
+                "parsig send failed; retrying until duty deadline",
+                topic="parsigex",
+                duty=str(duty),
+                err=f"{type(e).__name__}: {e}",
+            )
+            task = asyncio.create_task(self._resend(duty, signed_set))
+            self._retry_tasks.add(task)
+            task.add_done_callback(self._retry_tasks.discard)
+
+    async def _resend(self, duty: Duty, signed_set) -> None:
+        import asyncio
+
+        from charon_tpu.app.expbackoff import FAST_CONFIG, backoff_delay
+
+        deadline = self.clock.duty_deadline(duty)
+        attempt = 0
+        while True:
+            delay = backoff_delay(FAST_CONFIG, attempt)
+            if time.time() + delay >= deadline:
+                return  # deadline exhausted; tracker reports the miss
+            await asyncio.sleep(delay)
+            attempt += 1
+            try:
+                await self.transport.send(self.share_idx, duty, signed_set)
+                self.resend_total += 1
+                return
+            except _transient():
+                continue
 
     async def receive(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> None:
         """Peer partials arrive; gate, verify, then store

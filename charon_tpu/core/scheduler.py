@@ -116,10 +116,23 @@ class Scheduler:
         for t in self._tasks:
             t.cancel()
 
+    def reset(self) -> None:
+        """Clear a previous stop() so run() can be re-entered — the
+        RESTART boundary owns this, not run() itself: clearing inside
+        run() would erase a stop() issued between task creation and the
+        task's first execution, leaving the node unstoppable."""
+        self._stop.clear()
+
     async def run(self) -> None:
         """Tick slots until stopped (ref: scheduler.go:97 Run). Waits for
         beacon sync first, retrying single-shot probes (ref:
-        scheduler.go:678 waitBeaconSync)."""
+        scheduler.go:678 waitBeaconSync).
+
+        Re-runnable after stop() + reset(): a crashed-then-restarted
+        node calls run() again on the same wired components
+        (crash/recover scenarios; ref: charon's crash-only model
+        restarts the whole wiring, the asyncio analogue restarts the
+        tick loop)."""
         while not self._stop.is_set():
             try:
                 await self.beacon.await_synced()
@@ -153,8 +166,31 @@ class Scheduler:
 
     async def _handle_slot(self, slot: Slot) -> None:
         for sub in self._slot_subs:
-            await sub(slot)
-        await self._resolve_epoch(slot.epoch)
+            # slot observers (inclusion checker, infosync, recaster) are
+            # isolated: one observer hitting a flaky BN must not kill
+            # the duty tick loop for every remaining slot
+            try:
+                await sub(slot)
+            except Exception as e:  # noqa: BLE001
+                from charon_tpu.app import log
+
+                log.warn(
+                    "slot subscriber failed",
+                    topic="scheduler",
+                    slot=slot.slot,
+                    err=f"{type(e).__name__}: {e}",
+                )
+        try:
+            await self._resolve_epoch(slot.epoch)
+        except Exception as e:  # noqa: BLE001 — degraded: retry next slot
+            from charon_tpu.app import log
+
+            log.warn(
+                "epoch duty resolution failed; retrying next slot",
+                topic="scheduler",
+                epoch=slot.epoch,
+                err=f"{type(e).__name__}: {e}",
+            )
         duties = self._defs.get(slot.epoch, {})
         for duty, defs in duties.items():
             if duty.slot != slot.slot:

@@ -469,6 +469,14 @@ def tracking(tracker: Tracker):
                 result = await fn(duty, *args, **kwargs)
             except Exception as e:
                 tracker.step_failed(duty, steps[-1], e)
+                # The edge being INVOKED already proves its input-side
+                # steps (e.g. a VC submitting partials proves
+                # VALIDATOR_API even when the store's downstream fan-out
+                # raises) — without this, one transient peer error
+                # cascades back through the awaited chain and the
+                # tracker misattributes the duty one step too early.
+                for step in steps[:-1]:
+                    tracker.step_event(duty, step)
                 raise
             for step in steps:
                 tracker.step_event(duty, step)

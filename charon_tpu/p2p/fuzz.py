@@ -1,59 +1,32 @@
-"""P2P chaos fuzzing: corrupt the wire to prove peers survive garbage.
+"""P2P chaos fuzzing — compatibility shim over `testutil/chaos`.
 
-Mirrors ref: p2p/fuzz.go:18-30 — a fuzzing reader/writer injected into the
-sender for chaos testing (enabled by --p2p-fuzz, app/app.go:253-256). Here
-a wrapper around P2PNode.send that randomly corrupts/drops/duplicates
-frames, plus a raw-socket garbage blaster for the server side.
+Mirrors ref: p2p/fuzz.go:18-30 (a fuzzing reader/writer injected into
+the sender, enabled by --p2p-fuzz). The implementation moved into the
+seeded fault-injection plane (`charon_tpu/testutil/chaos.py`), which
+adds partitions, crash/restart, delay/reorder and deterministic
+substreams; this module keeps the original one-call surface for
+existing callers.
 """
 
 from __future__ import annotations
 
-import asyncio
-import random
+from charon_tpu.testutil.chaos import (  # noqa: F401 — re-exported API
+    ChaosConfig,
+    blast_garbage,
+    chaos_p2p_node,
+)
 
 
 def fuzz_node(node, rate: float = 0.2, seed: int = 0) -> None:
-    """Wrap node.send with probabilistic corruption (SetFuzzerDefaultsUnsafe
-    analogue). Receivers must survive: bad frames are dropped by codec/
-    handler error paths, never crash the process."""
-    rng = random.Random(seed)
-    orig_send = node.send
-
-    async def fuzzed_send(peer_idx, protocol, msg, await_response=False):
-        roll = rng.random()
-        if roll < rate / 3:
-            return None  # drop
-        if roll < 2 * rate / 3:
-            # corrupt: send garbage bytes on the raw connection
-            try:
-                conn = await node._get_conn(peer_idx)
-                garbage = rng.randbytes(rng.randrange(1, 64))
-                from charon_tpu.p2p.transport import _write_frame
-
-                async with conn.lock:
-                    _write_frame(conn.writer, garbage)
-                    await conn.writer.drain()
-            except Exception:
-                pass
-            if await_response:
-                raise TimeoutError("fuzzed request")
-            return None
-        if roll < rate:
-            await orig_send(peer_idx, protocol, msg)  # duplicate
-        return await orig_send(peer_idx, protocol, msg, await_response)
-
-    node.send = fuzzed_send
-
-
-async def blast_garbage(host: str, port: int, n_frames: int = 50, seed: int = 0) -> None:
-    """Open raw connections and write random bytes at the server —
-    handshake and framing must reject them without taking the node down."""
-    rng = random.Random(seed)
-    for _ in range(n_frames):
-        try:
-            reader, writer = await asyncio.open_connection(host, port)
-            writer.write(rng.randbytes(rng.randrange(1, 256)))
-            await writer.drain()
-            writer.close()
-        except ConnectionError:
-            pass
+    """Wrap node.send with probabilistic corruption
+    (SetFuzzerDefaultsUnsafe analogue): the historical `rate` splits
+    evenly into drop / corrupt / duplicate, as the old stub did."""
+    chaos_p2p_node(
+        node,
+        ChaosConfig(
+            seed=seed,
+            drop=rate / 3,
+            corrupt=rate / 3,
+            duplicate=rate / 3,
+        ),
+    )

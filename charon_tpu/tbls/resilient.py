@@ -1,0 +1,115 @@
+"""ResilientImpl: an ordered degradation ladder of tbls backends.
+
+The duty hot path must stay live when a crypto backend misbehaves
+(a wedged TPU runtime, a native library crash, a driver OOM): a backend
+*error* is infrastructure, not a crypto verdict, so the call is retried
+one rung down — TPU -> native C++ -> pure-python spec — and after
+`demote_after` consecutive primary failures the broken rung is demoted
+permanently (its jitted/compiled state is assumed wedged; re-probing a
+dead accelerator on every signature would add its failure latency to
+every duty).
+
+TblsError is NEVER caught here: failed verification or malformed inputs
+mean the same thing on every backend (they are bit-compatible — see
+tests/test_tbls.py cross-impl suite), so falling through on a verdict
+would only hide real signature failures.
+
+Used by app/run.py when more than one backend is available, and by the
+chaos suite (testutil/chaos.FlakyBackend forces the errors).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+from charon_tpu.tbls import Implementation, TblsError
+
+
+class ResilientImpl(Implementation):
+    """impls: backends in preference order (fastest first). All calls go
+    to the active rung; a non-TblsError failure retries the same call on
+    the next rung, and `demote_after` consecutive active-rung failures
+    demote the active rung for good."""
+
+    def __init__(
+        self, impls: Sequence[Implementation], demote_after: int = 2
+    ) -> None:
+        if not impls:
+            raise ValueError("need at least one tbls backend")
+        self.impls = list(impls)
+        self.demote_after = demote_after
+        self.active = 0
+        self.fallback_calls = 0  # calls served below the active rung
+        self.demotions: list[int] = []  # rung indices demoted, in order
+        self._fail_streak = 0
+
+    def _call(self, name: str, *args, **kwargs):
+        i = self.active
+        while True:
+            impl = self.impls[i]
+            try:
+                result = getattr(impl, name)(*args, **kwargs)
+            except TblsError:
+                raise  # crypto verdict: identical on every rung
+            except Exception as e:  # noqa: BLE001 — backend fault
+                if i + 1 >= len(self.impls):
+                    raise  # ladder exhausted: surface the fault
+                if i == self.active:
+                    self._fail_streak += 1
+                    if self._fail_streak >= self.demote_after:
+                        from charon_tpu.app import log
+
+                        log.warn(
+                            "tbls backend demoted",
+                            topic="tbls",
+                            rung=i,
+                            backend=type(impl).__name__,
+                            err=f"{type(e).__name__}: {str(e)[:120]}",
+                        )
+                        self.demotions.append(i)
+                        self.active = i + 1
+                        self._fail_streak = 0
+                i += 1
+                self.fallback_calls += 1
+                continue
+            if i == self.active:
+                self._fail_streak = 0
+            return result
+
+    # -- the 11-op contract + batch extensions, all via the ladder --------
+
+    def generate_secret_key(self):
+        return self._call("generate_secret_key")
+
+    def secret_to_public_key(self, secret):
+        return self._call("secret_to_public_key", secret)
+
+    def threshold_split(self, secret, total: int, threshold: int):
+        return self._call("threshold_split", secret, total, threshold)
+
+    def recover_secret(self, shares: Mapping[int, bytes], total: int, threshold: int):
+        return self._call("recover_secret", shares, total, threshold)
+
+    def sign(self, secret, data: bytes):
+        return self._call("sign", secret, data)
+
+    def verify(self, pubkey, data: bytes, sig) -> None:
+        return self._call("verify", pubkey, data, sig)
+
+    def verify_aggregate(self, pubkeys, data: bytes, sig) -> None:
+        return self._call("verify_aggregate", pubkeys, data, sig)
+
+    def threshold_aggregate(self, partials: Mapping[int, bytes]):
+        return self._call("threshold_aggregate", partials)
+
+    def aggregate(self, sigs):
+        return self._call("aggregate", sigs)
+
+    def verify_batch(self, items):
+        return self._call("verify_batch", items)
+
+    def threshold_aggregate_batch(self, batch):
+        return self._call("threshold_aggregate_batch", batch)
+
+    def aggregate_batch(self, groups):
+        return self._call("aggregate_batch", groups)

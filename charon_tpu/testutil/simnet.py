@@ -47,6 +47,39 @@ class SimCluster:
     share_keys: list[dict[PubKey, bytes]]  # per node
     pubshares_by_idx: dict[int, dict[PubKey, bytes]]
     nodes: list["SimNode"] = field(default_factory=list)
+    # set when built with chaos: the shared fault-injection handles
+    chaos_transport: object | None = None
+    chaos_qbft: object | None = None
+    partitioner: object | None = None
+
+    # -- chaos control (no-ops without a chaos build) ---------------------
+
+    def crash_node(self, share_idx: int) -> None:
+        """Crash-stop a node mid-run: its scheduler halts and the fault
+        plane black-holes its traffic in BOTH directions."""
+        node = self.nodes[share_idx - 1]
+        node.scheduler.stop()
+        if self.partitioner is not None:
+            self.partitioner.crash(share_idx)
+
+    def restart_node(self, share_idx: int):
+        """Restart a crashed node; returns the new scheduler task
+        (crash-only model: same wired components, fresh tick loop)."""
+        import asyncio
+
+        if self.partitioner is not None:
+            self.partitioner.restart(share_idx)
+        node = self.nodes[share_idx - 1]
+        node.scheduler.reset()
+        return asyncio.create_task(node.scheduler.run())
+
+    def partition(self, side_a, side_b, symmetric: bool = True) -> None:
+        assert self.partitioner is not None, "build_cluster(chaos=...) first"
+        self.partitioner.partition(side_a, side_b, symmetric)
+
+    def heal(self) -> None:
+        if self.partitioner is not None:
+            self.partitioner.heal()
 
 
 @dataclass
@@ -75,9 +108,15 @@ def build_cluster(
     use_qbft: bool = False,
     wire_vmock: bool = True,
     protocol_prefs: list[list[str]] | None = None,
+    chaos=None,  # testutil.chaos.ChaosConfig: seeded fault injection
 ) -> SimCluster:
     """Create keys and wire n in-process nodes (ref: app/app.go simnet +
-    cluster/test_cluster.go generator, redesigned for asyncio)."""
+    cluster/test_cluster.go generator, redesigned for asyncio).
+
+    With `chaos`, the cluster is built on the fault-injection plane:
+    chaos transports for parsig exchange and QBFT messages, a ChaosBeacon
+    around the shared mock, and a Partitioner for crash/restart and
+    partition/heal control (ISSUE 2 tentpole)."""
     impl = tbls.get_implementation()
 
     group_pubkeys: list[PubKey] = []
@@ -106,6 +145,13 @@ def build_cluster(
         slots_per_epoch=slots_per_epoch,
     )
 
+    partitioner = None
+    if chaos is not None:
+        from charon_tpu.testutil.chaos import ChaosBeacon, Partitioner
+
+        partitioner = Partitioner()
+        beacon = ChaosBeacon(beacon, chaos)
+
     cluster = SimCluster(
         n=n,
         t=t,
@@ -114,14 +160,27 @@ def build_cluster(
         group_pubkeys=group_pubkeys,
         share_keys=share_keys,
         pubshares_by_idx=pubshares_by_idx,
+        partitioner=partitioner,
     )
 
-    transport = MemTransport()
+    if chaos is not None:
+        from charon_tpu.testutil.chaos import ChaosParSigTransport
+
+        transport = ChaosParSigTransport(chaos, partitioner)
+        cluster.chaos_transport = transport
+    else:
+        transport = MemTransport()
     qbft_net = None
     if use_qbft:
-        from charon_tpu.core.consensus_qbft import MemMsgNet
+        if chaos is not None:
+            from charon_tpu.testutil.chaos import ChaosMsgNet
 
-        qbft_net = MemMsgNet()
+            qbft_net = ChaosMsgNet(chaos, partitioner)
+            cluster.chaos_qbft = qbft_net
+        else:
+            from charon_tpu.core.consensus_qbft import MemMsgNet
+
+            qbft_net = MemMsgNet()
     # priority negotiation fabric (opt-in: protocol_prefs per node)
     prio_fabric = None
     if protocol_prefs is not None:
@@ -185,7 +244,9 @@ def _build_node(
         slots_per_epoch=spe,
     )
     verifier = Eth2Verifier(fork, cluster.pubshares_by_idx, spe)
-    parsigex = ParSigEx(share_idx, transport, verifier)
+    # clock enables the deadline-aware resend when a chaos transport
+    # (or a real p2p link) raises on send
+    parsigex = ParSigEx(share_idx, transport, verifier, clock=beacon.clock())
     scheduler = Scheduler(
         beacon,
         beacon.clock(),
@@ -208,8 +269,14 @@ def _build_node(
     spawn_fetch = with_async_retry(retryer)
 
     # same tracker wiring as production (app/run.py): every edge feeds
-    # step/participation events; tests expire duties to get reports
-    tracker = Tracker(peer_share_indices=list(range(1, cluster.n + 1)))
+    # step/participation events; tests expire duties to get reports.
+    # threshold comes from the CLUSTER definition, not the quorum
+    # default — participation accounting must agree with parsigdb/sigagg
+    # about how many partials a validator needs (VERDICT weak #1).
+    tracker = Tracker(
+        peer_share_indices=list(range(1, cluster.n + 1)),
+        threshold=cluster.t,
+    )
 
     wire(
         scheduler=scheduler,

@@ -66,6 +66,9 @@ def test_retryer_retries_until_deadline():
         async def boom(duty):
             raise ValueError("programming error")
 
+        # fresh duty window (the clock ran past the previous deadline,
+        # and an expired duty never even starts — Deadliner semantics)
+        now[0] = 0.0
         with pytest.raises(ValueError):
             await r.retry("fetch", Duty(1, DutyType.ATTESTER), boom)
 

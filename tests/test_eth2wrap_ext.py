@@ -248,3 +248,34 @@ def test_expbackoff_schedule():
     # presets match the reference's configs (expbackoff.go:33,41)
     assert eb.DEFAULT_CONFIG.max_delay == 120.0
     assert eb.FAST_CONFIG.base_delay == 0.1
+
+
+def test_multiclient_hedge_none_result_not_double_invoked():
+    """A fast primary returning None (every submit_* endpoint does) must
+    count as SUCCESS on the hedged path: the explicit ok flag — not the
+    result value — decides, or every broadcast would be submitted twice
+    once latency history exists."""
+    from charon_tpu.app.eth2wrap import MultiClient
+
+    class VoidClient:
+        def __init__(self):
+            self.calls = 0
+
+        async def submit_attestation(self, att):
+            self.calls += 1
+            return None
+
+    a, b = VoidClient(), VoidClient()
+    mc = MultiClient([a, b], timeout=1.0)
+
+    async def main():
+        # warm both latency windows so the hedge path is armed
+        await mc.submit_attestation("att1")
+        mc.errors[0] += 1
+        await mc.submit_attestation("att2")
+        mc.errors[0] -= 1
+        before = a.calls + b.calls
+        await mc.submit_attestation("att3")
+        assert a.calls + b.calls == before + 1, "one submit, one invocation"
+
+    asyncio.run(main())

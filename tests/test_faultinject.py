@@ -1,0 +1,210 @@
+"""Unit coverage for the fault-injection plane: gating/inertness
+(app/faultinject), seeded determinism and spec parsing (testutil/chaos),
+and the tbls degradation ladder (tbls/resilient)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.app import faultinject
+from charon_tpu.tbls import TblsError
+from charon_tpu.tbls.python_impl import PythonImpl
+from charon_tpu.tbls.resilient import ResilientImpl
+from charon_tpu.testutil.chaos import (
+    ChaosBeacon,
+    ChaosConfig,
+    FlakyBackend,
+    Partitioner,
+    config_from_spec,
+)
+
+
+# -- gating: inert by default ------------------------------------------------
+
+
+def test_faultinject_inert_by_default():
+    """Zero overhead on the un-instrumented path: wrap helpers return
+    the ORIGINAL object (no wrapper constructed) while no plane is
+    installed (ISSUE 2 acceptance)."""
+    faultinject.uninstall()
+    sentinel = object()
+    assert not faultinject.active()
+    assert faultinject.maybe_wrap_beacon(sentinel) is sentinel
+    assert faultinject.maybe_wrap_tbls(sentinel) is sentinel
+    assert faultinject.maybe_wrap_p2p_node(sentinel) is sentinel
+
+
+def test_faultinject_env_gating():
+    faultinject.uninstall()
+    assert faultinject.init_from_env({}) is False
+    assert not faultinject.active()
+
+    assert (
+        faultinject.init_from_env(
+            {"CHARON_TPU_FAULT_INJECTION": "seed=7,bn_error=0.5"}
+        )
+        is True
+    )
+    assert faultinject.active()
+    assert faultinject.plane().config.seed == 7
+    assert faultinject.plane().config.bn_error == 0.5
+    faultinject.uninstall()
+
+
+def test_faultinject_wrap_beacon_when_active():
+    faultinject.uninstall()
+    faultinject.install("seed=1,bn_error=1.0")
+
+    class FakeBeacon:
+        async def attestation_data(self, slot, committee):
+            return {"slot": slot}
+
+    wrapped = faultinject.maybe_wrap_beacon(FakeBeacon())
+    assert isinstance(wrapped, ChaosBeacon)
+    with pytest.raises(ConnectionError):
+        asyncio.run(wrapped.attestation_data(1, 0))
+    faultinject.uninstall()
+
+
+# -- spec parsing ------------------------------------------------------------
+
+
+def test_config_from_spec_parses_fields_and_types():
+    cfg = config_from_spec(
+        "seed=42,drop=0.1,bn_burst_max=5,crypto_fail_after=3,delay_max=0.2"
+    )
+    assert cfg.seed == 42
+    assert cfg.drop == 0.1
+    assert cfg.bn_burst_max == 5
+    assert cfg.crypto_fail_after == 3
+    assert cfg.delay_max == 0.2
+
+
+def test_config_from_spec_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown fault-injection key"):
+        config_from_spec("seed=1,dorp=0.1")
+
+
+def test_config_from_spec_bare_enable():
+    cfg = config_from_spec("on")
+    assert cfg.drop == 0.0 and cfg.bn_error == 0.0
+
+
+# -- seeded determinism ------------------------------------------------------
+
+
+def test_chaos_streams_are_deterministic_and_independent():
+    cfg = ChaosConfig(seed=99)
+    a1 = [cfg.stream("parsig").random() for _ in range(5)]
+    a2 = [cfg.stream("parsig").random() for _ in range(5)]
+    b = [cfg.stream("beacon").random() for _ in range(5)]
+    assert a1 == a2, "same seed+label must replay the same schedule"
+    assert a1 != b, "labels must give independent substreams"
+    assert a1 != [ChaosConfig(seed=100).stream("parsig").random() for _ in range(5)]
+
+
+def test_chaos_beacon_burst_and_counters():
+    class FakeBeacon:
+        def __init__(self):
+            self.calls = 0
+
+        async def attestation_data(self, slot, committee):
+            self.calls += 1
+            return {"slot": slot}
+
+    inner = FakeBeacon()
+    chaos = ChaosBeacon(inner, ChaosConfig(seed=3, bn_error=0.5, bn_burst_max=3))
+
+    async def run():
+        outcomes = []
+        for i in range(40):
+            try:
+                await chaos.attestation_data(i, 0)
+                outcomes.append("ok")
+            except ConnectionError:
+                outcomes.append("err")
+        return outcomes
+
+    outcomes = asyncio.run(run())
+    assert chaos.injected_errors == outcomes.count("err") > 0
+    assert inner.calls == outcomes.count("ok") > 0
+    # deterministic: the same seed replays the exact same schedule
+    chaos2 = ChaosBeacon(FakeBeacon(), ChaosConfig(seed=3, bn_error=0.5, bn_burst_max=3))
+    assert asyncio.run(_replay(chaos2, 40)) == outcomes
+
+
+async def _replay(chaos, n):
+    out = []
+    for i in range(n):
+        try:
+            await chaos.attestation_data(i, 0)
+            out.append("ok")
+        except ConnectionError:
+            out.append("err")
+    return out
+
+
+# -- partitioner -------------------------------------------------------------
+
+
+def test_partitioner_asymmetric_and_heal():
+    part = Partitioner()
+    part.block(1, 4)
+    assert part.blocked(1, 4) and not part.blocked(4, 1)
+    part.partition({1, 2}, {4}, symmetric=True)
+    assert part.blocked(4, 2) and part.blocked(2, 4)
+    part.heal()
+    assert not part.blocked(1, 4) and not part.blocked(4, 2)
+    part.crash(3)
+    assert 3 in part.crashed
+    part.restart(3)
+    assert 3 not in part.crashed
+
+
+# -- crypto: FlakyBackend + ResilientImpl ladder -----------------------------
+
+
+def test_flaky_backend_fail_after():
+    flaky = FlakyBackend(PythonImpl(), fail_after=2)
+    flaky.generate_secret_key()
+    flaky.generate_secret_key()
+    with pytest.raises(RuntimeError, match="backend lost"):
+        flaky.generate_secret_key()
+    assert flaky.injected_failures == 1
+
+
+def test_resilient_ladder_demotes_dead_primary():
+    primary = FlakyBackend(PythonImpl(), fail_after=0)
+    ladder = ResilientImpl([primary, PythonImpl()], demote_after=2)
+
+    sk = ladder.generate_secret_key()  # falls through, streak 1
+    pk = ladder.secret_to_public_key(sk)  # falls through, streak 2 -> demote
+    assert ladder.demotions == [0]
+    assert ladder.active == 1
+    assert ladder.fallback_calls >= 2
+    # demoted: the dead rung is no longer consulted
+    before = primary.calls
+    sig = ladder.sign(sk, b"m" * 32)
+    ladder.verify(pk, b"m" * 32, sig)
+    assert primary.calls == before
+
+
+def test_resilient_ladder_never_retries_crypto_verdicts():
+    """TblsError (failed verification / malformed input) must surface
+    from the active rung — falling through would hide real signature
+    failures behind a 'healthy' lower backend."""
+    spy = PythonImpl()
+    ladder = ResilientImpl([PythonImpl(), spy], demote_after=2)
+    sk = ladder.generate_secret_key()
+    pk = ladder.secret_to_public_key(sk)
+    sig = ladder.sign(sk, b"a" * 32)
+    with pytest.raises(TblsError):
+        ladder.verify(pk, b"b" * 32, sig)  # wrong message: a VERDICT
+    assert ladder.active == 0 and not ladder.demotions
+
+
+def test_resilient_ladder_exhaustion_surfaces_the_fault():
+    dead = FlakyBackend(PythonImpl(), fail_after=0)
+    ladder = ResilientImpl([dead], demote_after=2)
+    with pytest.raises(RuntimeError, match="backend lost"):
+        ladder.generate_secret_key()

@@ -1,0 +1,283 @@
+"""Direct unit tests for app/retry (deadline + cancellation edges) and
+property-style bounds for app/expbackoff (ISSUE 2 satellites).
+
+The retry loop's contract is DEADLINE-bounded, not attempt-bounded: it
+must stop at the duty deadline no matter how the failures arrive (fast
+errors, hung calls, or cancellation from a torn-down duty).
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from charon_tpu.app import expbackoff as eb
+from charon_tpu.app.retry import Retryer, retryable_errors, with_async_retry
+
+DEADLINE = 100.0
+
+
+def _clock(start: float = 0.0):
+    """Fake time: [now], advance by mutating."""
+    state = [start]
+    return state, (lambda: state[0])
+
+
+# -- retryer: deadline exhaustion --------------------------------------------
+
+
+def test_retry_stops_at_deadline_not_attempt_count():
+    """Transient failures retry until the duty deadline and then STOP —
+    the count of attempts tracks the remaining window, never a fixed
+    attempt budget."""
+
+    async def run():
+        state, now = _clock(0.0)
+        calls = []
+
+        async def fn(duty):
+            calls.append(now())
+            state[0] += 3.0  # each attempt burns fake time
+            raise ConnectionError("flaky")
+
+        retryer = Retryer(
+            deadline_of=lambda duty: DEADLINE, now=now, backoff=0.0
+        )
+        await retryer.retry("step", "duty", fn)
+        # attempts ran until the clock crossed the deadline, then the
+        # loop returned WITHOUT raising (tracker owns the miss report)
+        assert len(calls) == 34  # ceil(100 / 3) + the pre-check stop
+        assert calls[-1] < DEADLINE <= calls[-1] + 3.0
+
+    asyncio.run(run())
+
+
+def test_retry_does_not_start_past_deadline():
+    async def run():
+        calls = []
+
+        async def fn(duty):
+            calls.append(1)
+
+        state, now = _clock(DEADLINE + 1)
+        retryer = Retryer(deadline_of=lambda d: DEADLINE, now=now)
+        await retryer.retry("step", "duty", fn)
+        assert calls == [], "an expired duty must not run even once"
+
+    asyncio.run(run())
+
+
+def test_retry_bounds_a_hung_call_by_the_deadline():
+    """A call that never returns is cancelled at the deadline (wait_for
+    window = remaining time) — a hung BN connection cannot drag a duty
+    past its slot."""
+
+    async def run():
+        started = []
+
+        async def hung(duty):
+            started.append(time.time())
+            await asyncio.sleep(3600)
+
+        t0 = time.time()
+        retryer = Retryer(
+            deadline_of=lambda d: t0 + 0.2, backoff=10.0
+        )
+        await asyncio.wait_for(retryer.retry("step", "duty", hung), 5.0)
+        assert len(started) == 1
+        assert time.time() - t0 < 2.0
+
+    asyncio.run(run())
+
+
+def test_retry_nonretryable_surfaces_immediately():
+    async def run():
+        calls = []
+
+        async def fn(duty):
+            calls.append(1)
+            raise ValueError("programming error")
+
+        retryer = Retryer(deadline_of=lambda d: time.time() + 60)
+        with pytest.raises(ValueError):
+            await retryer.retry("step", "duty", fn)
+        assert calls == [1]
+
+    asyncio.run(run())
+
+
+# -- retryer: cancellation ---------------------------------------------------
+
+
+def test_retry_cancellation_propagates_from_backoff_sleep():
+    """Cancelling the retry task (duty torn down / shutdown) stops the
+    loop immediately — CancelledError is never swallowed as a
+    'transient' failure and no further attempt runs."""
+
+    async def run():
+        calls = []
+
+        async def fn(duty):
+            calls.append(1)
+            raise ConnectionError("flaky")
+
+        retryer = Retryer(
+            deadline_of=lambda d: time.time() + 3600, backoff=30.0
+        )
+        task = asyncio.create_task(retryer.retry("step", "duty", fn))
+        await asyncio.sleep(0.05)  # first attempt + into the backoff sleep
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert calls == [1]
+
+    asyncio.run(run())
+
+
+def test_retry_cancellation_mid_call_propagates():
+    async def run():
+        entered = asyncio.Event()
+
+        async def fn(duty):
+            entered.set()
+            await asyncio.sleep(3600)
+
+        retryer = Retryer(deadline_of=lambda d: time.time() + 3600)
+        task = asyncio.create_task(retryer.retry("step", "duty", fn))
+        await entered.wait()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    asyncio.run(run())
+
+
+def test_spawned_retry_task_is_tracked_and_cancellable():
+    async def run():
+        async def fn(duty):
+            await asyncio.sleep(3600)
+
+        retryer = Retryer(deadline_of=lambda d: time.time() + 3600)
+        retryer.spawn("step", "duty", fn)
+        assert len(retryer._tasks) == 1
+        task = next(iter(retryer._tasks))
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        assert not retryer._tasks, "done callback must drop the task"
+
+    asyncio.run(run())
+
+
+def test_with_async_retry_only_wraps_selected_edges():
+    async def run():
+        retryer = Retryer(deadline_of=lambda d: time.time() + 60)
+        option = with_async_retry(retryer, edges={"fetcher.fetch"})
+
+        async def fn(duty):
+            return "inline"
+
+        assert option("sigagg.aggregate", fn) is fn
+        wrapped = option("fetcher.fetch", fn)
+        assert wrapped is not fn
+        await wrapped("duty")  # spawns; returns immediately
+        await asyncio.gather(*retryer._tasks, return_exceptions=True)
+
+    asyncio.run(run())
+
+
+def test_retryable_errors_cover_the_framework_transients():
+    errs = retryable_errors()
+    from charon_tpu.app.eth2wrap import AllClientsFailedError
+
+    for exc in (
+        ConnectionError("x"),
+        TimeoutError("x"),
+        OSError("x"),
+        AllClientsFailedError("every BN down"),
+    ):
+        assert isinstance(exc, errs)
+    assert not isinstance(ValueError("x"), errs)
+
+
+# -- expbackoff: property-style bounds ---------------------------------------
+
+
+def test_backoff_delay_bounds_all_attempts_and_configs():
+    """For every attempt number and many rng draws, the jittered delay
+    stays within [base*(1-jitter), max*(1+jitter)] and is never
+    negative; the unjittered schedule is monotone non-decreasing and
+    capped at max_delay."""
+    for config in (eb.DEFAULT_CONFIG, eb.FAST_CONFIG):
+        lo = config.base_delay * (1 - config.jitter)
+        hi = config.max_delay * (1 + config.jitter)
+        rng = random.Random(7)
+        for retries in list(range(64)) + [10_000]:
+            for _ in range(25):
+                delay = eb.backoff_delay(config, retries, rng=rng)
+                assert delay >= 0.0
+                assert lo <= delay <= hi, (config, retries, delay)
+
+        # degenerate rng at BOTH jitter extremes stays inside the bounds
+        class Extreme:
+            def __init__(self, value):
+                self.value = value
+
+            def random(self):
+                return self.value
+
+        for retries in (0, 1, 7, 500):
+            assert (
+                lo
+                <= eb.backoff_delay(config, retries, rng=Extreme(0.0))
+                <= hi
+            )
+            assert (
+                lo
+                <= eb.backoff_delay(config, retries, rng=Extreme(1.0))
+                <= hi
+            )
+
+
+def test_backoff_delay_unjittered_schedule_monotone_and_capped():
+    config = eb.Config(base_delay=0.5, multiplier=1.6, jitter=0.0, max_delay=30.0)
+
+    class Mid:
+        def random(self):
+            return 0.5  # jitter term vanishes at jitter=0 anyway
+
+    prev = 0.0
+    for retries in range(64):
+        delay = eb.backoff_delay(config, retries, rng=Mid())
+        assert delay >= prev
+        assert delay <= config.max_delay
+        prev = delay
+    assert prev == config.max_delay, "schedule must reach the cap"
+
+
+def test_backoff_delay_negative_retries_clamp_to_base():
+    assert eb.backoff_delay(
+        eb.Config(jitter=0.0), -5
+    ) == eb.DEFAULT_CONFIG.base_delay
+
+
+def test_expbackoff_stateful_delays_within_bounds_and_reset():
+    bo = eb.ExpBackoff(base=0.25, factor=2.0, max_delay=3.0, jitter=True)
+    random.seed(11)
+    for _ in range(50):
+        assert 0.0 <= bo.next_delay() <= 3.0
+    bo.reset()
+    bo.jitter = False
+    assert bo.next_delay() == 0.25, "reset must restart the schedule"
+    assert bo.next_delay() == 0.5
+
+
+def test_expbackoff_first_wait_returns_immediately():
+    async def run():
+        bo = eb.ExpBackoff(base=5.0, jitter=False)
+        t0 = time.monotonic()
+        await bo.wait()  # first call: no sleep, no attempt consumed
+        assert time.monotonic() - t0 < 0.1
+        assert bo._attempt == 0
+
+    asyncio.run(run())

@@ -58,19 +58,9 @@ def test_all_duties_schema_conformant():
                 await vm.register(pubkey)
                 await vm.exit(pubkey, epoch=0)
 
-            async def all_done():
-                while (
-                    len(beacon.attestations) < 4
-                    or len(beacon.proposals) < 4
-                    or len(beacon.aggregates) < 4
-                    or len(beacon.sync_messages) < 4
-                    or len(beacon.contributions) < 4
-                    or len(beacon.registrations) < 4
-                    or len(beacon.exits) < 4
-                ):
-                    await asyncio.sleep(0.05)
+            from charon_tpu.testutil.waiting import wait_for_broadcasts
 
-            await asyncio.wait_for(all_done(), timeout=120)
+            await wait_for_broadcasts(beacon, want=4)
 
             # metadata surface a stock VC reads at startup — validated
             # through the same schema-checked client

@@ -32,6 +32,25 @@ def host_tbls():
     tbls.set_implementation(PythonImpl())
 
 
+def _atts_completed_by_all(beacon, n: int = 4):
+    """Slots for which all n nodes broadcast an attestation. Grouping by
+    slot (instead of slicing the first n broadcasts) keeps the check
+    correct when a starved event loop skews nodes across slot
+    boundaries — the first n entries then MIX slots and carry different
+    (all valid) signatures."""
+    by_slot: dict[int, list] = {}
+    for a in beacon.attestations:
+        by_slot.setdefault(a.data.slot, []).append(a)
+    return {s: atts for s, atts in by_slot.items() if len(atts) >= n}
+
+
+def _props_completed_by_all(beacon, n: int = 4):
+    by_slot: dict[int, list] = {}
+    for proposal, sig in beacon.proposals:
+        by_slot.setdefault(proposal.slot, []).append((proposal, sig))
+    return {s: ps for s, ps in by_slot.items() if len(ps) >= n}
+
+
 async def _drive_and_check(cluster):
     tasks = [
         asyncio.create_task(node.scheduler.run()) for node in cluster.nodes
@@ -40,7 +59,10 @@ async def _drive_and_check(cluster):
     try:
 
         async def all_done():
-            while len(beacon.attestations) < 4 or len(beacon.proposals) < 4:
+            while (
+                not _atts_completed_by_all(beacon)
+                or not _props_completed_by_all(beacon)
+            ):
                 await asyncio.sleep(0.05)
 
         await asyncio.wait_for(all_done(), timeout=60)
@@ -49,7 +71,7 @@ async def _drive_and_check(cluster):
             node.scheduler.stop()
         await asyncio.gather(*tasks, return_exceptions=True)
 
-    atts = beacon.attestations[:4]
+    atts = next(iter(_atts_completed_by_all(beacon).values()))[:4]
     # all nodes recovered the SAME group signature
     sigs = {a.signature for a in atts}
     assert len(sigs) == 1
@@ -62,7 +84,7 @@ async def _drive_and_check(cluster):
     tbls.verify(pubkey_to_bytes(group_pk), root, att.signature)
 
     # proposer flow: all nodes broadcast the same valid signed block
-    props = beacon.proposals[:4]
+    props = next(iter(_props_completed_by_all(beacon).values()))[:4]
     psigs = {sig for _, sig in props}
     assert len(psigs) == 1
     proposal, psig = props[0]
@@ -173,9 +195,11 @@ def test_simnet_tracker_names_silenced_node():
         try:
 
             async def all_done():
-                # ALL FOUR nodes still broadcast: the silent node's peers
-                # supply threshold partials, so its own workflow completes
-                while len(beacon.attestations) < 4:
+                # ALL FOUR nodes still broadcast for ONE slot: the silent
+                # node's peers supply threshold partials, so its own
+                # workflow completes (grouped by slot — see
+                # _atts_completed_by_all)
+                while not _atts_completed_by_all(beacon):
                     await asyncio.sleep(0.05)
 
             await asyncio.wait_for(all_done(), timeout=60)
@@ -186,7 +210,10 @@ def test_simnet_tracker_names_silenced_node():
 
         from charon_tpu.core.types import Duty, DutyType
 
-        duty = Duty(beacon.attestations[0].data.slot, DutyType.ATTESTER)
+        # analyse a slot every node completed — the tracker on node 0
+        # must have its own full event trail for it
+        slot = next(iter(_atts_completed_by_all(beacon)))
+        duty = Duty(slot, DutyType.ATTESTER)
         report = await cluster.nodes[0].tracker.duty_expired(duty)
         assert report.success
         # shares 1-3 participated; share 4 is named absent
