@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""bench_hostplane.py — event-loop stall + pipeline overlap microbench
+for the coalescer's pipelined host plane (ISSUE 3 acceptance).
+
+Simulates a slot-tick burst of partial-signature verifies hitting the
+SlotCoalescer and measures, for the pre-pipeline synchronous decode path
+(decode_workers=0 — decompression + hash-to-curve inline on the event
+loop) vs the pipelined decode pool:
+
+  * event-loop max stall — a 1 ms asyncio ticker's worst scheduling gap
+    while the burst decodes (the QBFT/p2p latency the node would eat);
+  * submit -> result latency per submission;
+  * pipeline overlap — wall-clock seconds the decode/pack stages of
+    window k ran while the device still executed window k-1 (> 0 only
+    with double-buffered flushes).
+
+The device is a wall-clock fake (SimPlane sleeps a configurable program
+time and records busy spans), so the bench isolates HOST plane behavior
+and runs without jax — CPU-only, CI-safe. Real decode work is used:
+pure-python point decompression and hash-to-curve, the exact bigint
+work the decode pool exists to move off the loop.
+
+`--smoke` (ci.sh fast tier) runs tiny shapes and FAILS (exit 1) when
+the stall improvement ratio drops below --assert-ratio or the overlap
+hits zero — the event-loop-stall regression guard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import threading
+import time
+
+
+class SimPlane:
+    """Wall-clock device stand-in: each flush 'executes' for device_s
+    seconds and records its busy span. `busy` (threading.Event) lets the
+    driver submit the next window precisely while a program is in
+    flight."""
+
+    def __init__(self, t: int, device_s: float):
+        self.t = t
+        self.device_s = device_s
+        self.spans: list[tuple[float, float]] = []
+        self.busy = threading.Event()
+
+    def verify_host(self, pks, msgs, sigs, rng=None):
+        t0 = time.monotonic()
+        self.busy.set()
+        time.sleep(self.device_s)
+        self.busy.clear()
+        self.spans.append((t0, time.monotonic()))
+        return [True] * len(pks)
+
+    def recombine_host(self, pubshares, msgs, partials, group_pks,
+                       indices, rng=None):
+        t0 = time.monotonic()
+        self.busy.set()
+        time.sleep(self.device_s)
+        self.busy.clear()
+        self.spans.append((t0, time.monotonic()))
+        return [None] * len(msgs), [True] * len(msgs)
+
+
+def _merge(spans):
+    out = []
+    for s, e in sorted(spans):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def overlap_seconds(a, b) -> float:
+    """Total intersection length between two span lists."""
+    total = 0.0
+    for s1, e1 in _merge(a):
+        for s2, e2 in _merge(b):
+            total += max(0.0, min(e1, e2) - max(s1, s2))
+    return total
+
+
+def make_burst(lanes: int):
+    """lanes distinct (pk, root, sig) items: distinct roots so every
+    lane pays hash-to-curve, distinct sigs so every lane pays
+    decompression (the caches only amortize the pubkey, as live traffic
+    does)."""
+    from charon_tpu.tbls.python_impl import PythonImpl
+
+    impl = PythonImpl()
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    items = []
+    for i in range(lanes):
+        root = i.to_bytes(32, "big")
+        items.append((pk, root, impl.sign(sk, root)))
+    return items
+
+
+def _clear_decode_caches():
+    from charon_tpu.tbls.tpu_impl import _cached_msg_point, _cached_pubkey_point
+
+    _cached_msg_point.cache_clear()
+    _cached_pubkey_point.cache_clear()
+
+
+async def _stall_probe(stop: asyncio.Event, interval: float = 0.001):
+    """Worst scheduling gap of a 1 ms ticker — the event-loop stall."""
+    max_gap = 0.0
+    last = time.monotonic()
+    while not stop.is_set():
+        await asyncio.sleep(interval)
+        now = time.monotonic()
+        max_gap = max(max_gap, now - last - interval)
+        last = now
+    return max_gap
+
+
+async def run_phase(
+    items, decode_workers: int, submissions: int, window: float,
+    device_s: float,
+) -> dict:
+    from charon_tpu.core.cryptoplane import SlotCoalescer
+
+    _clear_decode_caches()
+    plane = SimPlane(t=3, device_s=device_s)
+    coal = SlotCoalescer(
+        plane, window=window, decode_workers=decode_workers, trace=True
+    )
+    stop = asyncio.Event()
+    probe = asyncio.create_task(_stall_probe(stop))
+    await asyncio.sleep(0.05)  # let the ticker settle
+
+    # window k: the slot-tick burst, split across concurrent submissions
+    # (ParSigEx inbound sets / VC pubshare checks / SigAgg)
+    half = items[: len(items) // 2]
+    k = max(1, len(half) // submissions)
+    chunks = [half[i : i + k] for i in range(0, len(half), k)]
+    t0 = time.monotonic()
+    latencies: list[float] = []
+
+    async def submit(chunk):
+        ts = time.monotonic()
+        res = await coal.verify(chunk)
+        latencies.append(time.monotonic() - ts)
+        return res
+
+    first = asyncio.gather(*(submit(c) for c in chunks))
+
+    # window k+1: submitted the moment window k's device program starts,
+    # so its decode/pack stages can only proceed concurrently with the
+    # in-flight program when the plane double-buffers
+    async def second_window():
+        while not plane.busy.is_set():
+            await asyncio.sleep(0.001)
+        return await submit(items[len(items) // 2 :])
+
+    res2 = await second_window()
+    res1 = await first
+    wall = time.monotonic() - t0
+    stop.set()
+    stall = await probe
+    assert all(all(r) for r in res1) and all(res2)
+    coal.close()
+
+    host_spans = coal.decode_spans + coal.pack_spans
+    return {
+        "decode_workers": decode_workers,
+        "lanes": len(items),
+        "submissions": len(chunks) + 1,
+        "flushes": coal.flushes,
+        "wall_seconds": round(wall, 4),
+        "loop_max_stall_seconds": round(stall, 4),
+        "submit_latency_max_seconds": round(max(latencies), 4),
+        "submit_latency_mean_seconds": round(
+            sum(latencies) / len(latencies), 4
+        ),
+        "host_device_overlap_seconds": round(
+            overlap_seconds(host_spans, coal.device_spans), 4
+        ),
+        "overlapped_flushes": coal.overlapped_flushes,
+        "max_inflight": coal.max_inflight,
+    }
+
+
+async def _measure(args, items):
+    sync = await run_phase(
+        items, 0, args.submissions, args.window, args.device_seconds
+    )
+    piped = await run_phase(
+        items, args.decode_workers, args.submissions, args.window,
+        args.device_seconds,
+    )
+    ratio = sync["loop_max_stall_seconds"] / max(
+        piped["loop_max_stall_seconds"], 1e-6
+    )
+    return sync, piped, ratio
+
+
+async def main(args) -> int:
+    lanes = 32 if args.smoke else args.lanes
+    print(f"# generating {lanes}-lane burst (pure-python signing) ...")
+    t0 = time.monotonic()
+    items = make_burst(lanes)
+    print(f"# setup {time.monotonic() - t0:.1f}s")
+
+    if args.device_seconds <= 0:
+        # auto-calibrate: the simulated program must outlast window
+        # k+1's decode (GIL makes pure-python decode effectively serial
+        # across pool threads) or the double-buffering measurement
+        # never engages. Measure per-lane decode cost, size the device
+        # window to the second burst's decode wall plus margin.
+        from charon_tpu.core.cryptoplane import _decode_verify_lane
+
+        _clear_decode_caches()
+        t0 = time.monotonic()
+        for it in items[:8]:
+            _decode_verify_lane(it)
+        per_lane = (time.monotonic() - t0) / 8
+        args.device_seconds = max(1.0, per_lane * (len(items) // 2) * 1.5)
+        print(f"# calibrated device window: {args.device_seconds:.1f}s "
+              f"({per_lane * 1000:.0f} ms/lane decode)")
+    want = args.assert_ratio or (3.0 if args.smoke else 0.0)
+
+    def gates_ok(piped, ratio):
+        return (
+            ratio >= want
+            and piped["host_device_overlap_seconds"] > 0
+            and piped["max_inflight"] >= 2
+        )
+
+    sync, piped, ratio = await _measure(args, items)
+    # the gates are wall-clock: on a contended CI runner one noisy
+    # measurement must not fail the tier — remeasure before concluding
+    # a regression (a genuine one, e.g. decode back on the loop or a
+    # serialized pipeline, fails every attempt)
+    attempts = 1
+    while want and not gates_ok(piped, ratio) and attempts < 3:
+        print(f"# gates not met (ratio {ratio:.1f}x, inflight "
+              f"{piped['max_inflight']}) — remeasuring "
+              f"(attempt {attempts + 1}/3, load transient?)")
+        sync, piped, ratio = await _measure(args, items)
+        attempts += 1
+    report = {
+        "bench": "hostplane",
+        "smoke": args.smoke,
+        "sync": sync,
+        "pipelined": piped,
+        "stall_improvement_ratio": round(ratio, 1),
+        "measure_attempts": attempts,
+    }
+    print(json.dumps(report, indent=2))
+    print(
+        f"# loop stall {sync['loop_max_stall_seconds'] * 1000:.0f} ms -> "
+        f"{piped['loop_max_stall_seconds'] * 1000:.0f} ms  ({ratio:.0f}x), "
+        f"host/device overlap {piped['host_device_overlap_seconds'] * 1000:.0f} ms, "
+        f"inflight depth {piped['max_inflight']}"
+    )
+    if want:
+        if ratio < want:
+            print(
+                f"FAIL: stall improvement {ratio:.1f}x < {want}x "
+                f"on {attempts} attempts (event-loop stall regression)"
+            )
+            return 1
+        if piped["host_device_overlap_seconds"] <= 0:
+            print("FAIL: no host/device overlap — pipeline broken")
+            return 1
+        if piped["max_inflight"] < 2:
+            print(
+                "FAIL: device lane never held 2 flushes — "
+                "double-buffering broken"
+            )
+            return 1
+        print("smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--lanes", type=int, default=256,
+                    help="burst size (verify lanes)")
+    ap.add_argument("--submissions", type=int, default=4,
+                    help="concurrent submissions the first window splits into")
+    ap.add_argument("--window", type=float, default=0.02)
+    ap.add_argument("--decode-workers", type=int, default=4)
+    ap.add_argument("--device-seconds", type=float, default=0.0,
+                    help="simulated device program wall time per flush; "
+                    "0 (default) auto-calibrates to outlast the next "
+                    "window's decode so the double-buffering "
+                    "measurement engages")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + regression assertions (CI fast tier)")
+    ap.add_argument("--assert-ratio", type=float, default=0.0,
+                    help="fail unless stall improves by at least this factor")
+    raise SystemExit(asyncio.run(main(ap.parse_args())))

@@ -149,6 +149,48 @@ class ClusterMetrics:
             "tpu_plane_lanes_total",
             "Crypto lanes executed through the coalesced plane",
         )
+        # pipelined host plane (ISSUE 3): per-flush latency/occupancy,
+        # decode-pool queueing, bucket-padding waste, device-lane depth
+        self.plane_flush_seconds = Histogram(
+            "tpu_plane_flush_seconds",
+            "Device-lane wall clock per coalescer flush (pack excluded)",
+            labels,
+            registry=self.registry,
+            buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.5, 2.0, 10.0, 60.0),
+        )
+        self.plane_lanes_per_flush = Histogram(
+            "tpu_plane_lanes_per_flush",
+            "Crypto lanes merged into each coalescer flush (occupancy)",
+            labels,
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024),
+        )
+        self.plane_decode_queue_seconds = Histogram(
+            "tpu_plane_decode_queue_seconds",
+            "Decode-pool queue delay per decode chunk (submit -> start)",
+            labels,
+            registry=self.registry,
+            buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0),
+        )
+        self.plane_pad_waste = Gauge(
+            "tpu_plane_pad_waste_ratio",
+            "Bucket-padding lanes / padded lanes of the most recent "
+            "flush (shape-bucket overhead)",
+            labels,
+            registry=self.registry,
+        )
+        self.plane_inflight = Gauge(
+            "tpu_plane_inflight_depth",
+            "Device-lane depth when the most recent flush was submitted "
+            "(>= 2 means flushes are double-buffering)",
+            labels,
+            registry=self.registry,
+        )
+        self.plane_overlapped = counter(
+            "tpu_plane_overlapped_flushes_total",
+            "Flushes whose host stages overlapped a device program "
+            "still in flight (double-buffered windows)",
+        )
 
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)

@@ -72,7 +72,18 @@ class Config:
     # it when >= 2 devices are visible (single-chip keeps the cheaper
     # single-device TPUImpl path), "on" forces it, "off" disables
     crypto_plane: str = "auto"
-    crypto_plane_window: float = 0.02  # coalescing window, seconds
+    crypto_plane_window: float = 0.02  # base coalescing window, seconds
+    # adaptive window bounds: grows toward max under sustained load,
+    # duty deadlines shrink it down to min (core/cryptoplane)
+    crypto_plane_window_min: float = 0.002
+    crypto_plane_window_max: float = 0.08
+    # decode/pack pool size; 0 disables the pipelined host plane (decode
+    # runs synchronously on the event loop — the pre-pipeline path)
+    crypto_plane_decode_workers: int = 4
+    # startup compile of the canonical duty shapes: "auto" pre-warms
+    # only on a real accelerator backend (CPU test runs skip the
+    # minutes-long pairing compiles), "on" forces, "off" disables
+    crypto_plane_prewarm: str = "auto"
     # OTLP/HTTP collector for workflow spans (ref: --jaeger-address,
     # app/app.go:1014-1027 wireTracing); "" disables export
     tracing_endpoint: str = ""
@@ -173,12 +184,16 @@ async def build_node(config: Config) -> Node:
                     plane_factory(),
                     window=config.crypto_plane_window,
                     plane_factory=plane_factory,
+                    window_min=config.crypto_plane_window_min,
+                    window_max=config.crypto_plane_window_max,
+                    decode_workers=config.crypto_plane_decode_workers,
                 )
                 log.info(
                     "crypto plane installed",
                     topic="app",
                     devices=n_devices,
                     window=config.crypto_plane_window,
+                    decode_workers=config.crypto_plane_decode_workers,
                 )
     else:
         # host path: prefer the native C++ backend — pure-Python pairing
@@ -222,14 +237,28 @@ async def build_node(config: Config) -> Node:
         peer=f"node{config.node_index}",
     )
     if crypto_plane is not None:
-
-        def _plane_metrics(jobs: int, lanes: int) -> None:
+        # one rich per-flush stats hook (runs on the device worker
+        # thread — prometheus client objects are thread-safe)
+        def _plane_stats(s) -> None:
             metrics.labels(metrics.plane_flushes).inc()
-            if jobs >= 2:
+            if s.jobs >= 2:
                 metrics.labels(metrics.plane_coalesced).inc()
-            metrics.labels(metrics.plane_lanes).inc(lanes)
+            metrics.labels(metrics.plane_lanes).inc(s.lanes)
+            metrics.labels(metrics.plane_flush_seconds).observe(
+                s.flush_seconds
+            )
+            metrics.labels(metrics.plane_lanes_per_flush).observe(s.lanes)
+            for q in s.decode_queue_seconds:
+                metrics.labels(metrics.plane_decode_queue_seconds).observe(q)
+            if s.padded_lanes:
+                metrics.labels(metrics.plane_pad_waste).set(
+                    s.pad_lanes / s.padded_lanes
+                )
+            metrics.labels(metrics.plane_inflight).set(s.inflight)
+            if s.inflight >= 2:
+                metrics.labels(metrics.plane_overlapped).inc()
 
-        crypto_plane.metrics_hook = _plane_metrics
+        crypto_plane.stats_hook = _plane_stats
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -370,6 +399,7 @@ async def build_node(config: Config) -> Node:
         slots_per_epoch=config.slots_per_epoch,
         plane=crypto_plane,
         pubshares_by_idx=pubshares_by_idx if crypto_plane else None,
+        clock=clock if crypto_plane else None,
     )
     # impl selected by the AGG_SIG_DB_V2 feature flag (ref: app wiring
     # gates memory_v2 behind the alpha flag)
@@ -410,7 +440,11 @@ async def build_node(config: Config) -> Node:
         plane=crypto_plane,
     )
     verifier = Eth2Verifier(
-        fork, pubshares_by_idx, config.slots_per_epoch, plane=crypto_plane
+        fork,
+        pubshares_by_idx,
+        config.slots_per_epoch,
+        plane=crypto_plane,
+        clock=clock if crypto_plane else None,
     )
     parsigex = ParSigEx(
         share_idx, parsig_transport, verifier, gater=duty_gater
@@ -631,6 +665,50 @@ async def build_node(config: Config) -> Node:
         scheduler.stop()
 
     life.register_stop(Order.SCHEDULER, "scheduler", stop_sched)
+
+    if crypto_plane is not None:
+        prewarm = config.crypto_plane_prewarm
+        if prewarm == "auto":
+            # pairing compiles take minutes on XLA:CPU — only a real
+            # accelerator backend amortizes the warmup
+            prewarm = "on" if jax.default_backend() == "tpu" else "off"
+        if prewarm == "on":
+            # background: duties arriving mid-warmup queue behind the
+            # compile on the serialized device lane instead of racing it
+            async def prewarm_plane():
+                import time as _t
+
+                t0 = _t.monotonic()
+                try:
+                    shapes = await crypto_plane.prewarm()
+                except Exception as e:  # noqa: BLE001 — background task:
+                    # lifecycle gathers it silently at shutdown, so a
+                    # failed warmup (wedged claim, compile error) must
+                    # log here or the operator believes the shapes are
+                    # warm while the first live slot eats a cold compile
+                    log.warn(
+                        "crypto plane pre-warm failed; first live "
+                        "flushes will compile cold",
+                        topic="app",
+                        err=f"{type(e).__name__}: {str(e)[:160]}",
+                        seconds=round(_t.monotonic() - t0, 1),
+                    )
+                    return
+                log.info(
+                    "crypto plane pre-warmed",
+                    topic="app",
+                    shapes=[(k, n) for k, n, _ in shapes],
+                    seconds=round(_t.monotonic() - t0, 1),
+                )
+
+            life.register_start(
+                Order.MONITORING, "crypto-prewarm", prewarm_plane
+            )
+
+        async def stop_plane():
+            crypto_plane.close()
+
+        life.register_stop(Order.SCHEDULER, "crypto-plane", stop_plane)
 
     # health: the reference catalogue evaluated over this node's own
     # sampled metrics, gating /readyz (ref: app/health + monitoringapi)

@@ -139,6 +139,27 @@ def build_parser() -> argparse.ArgumentParser:
         ">= 2 devices are visible (see core/cryptoplane.py)",
     )
     runp.add_argument(
+        "--crypto-plane-window",
+        type=float,
+        default=float(_env_default("crypto-plane-window", 0.02)),
+        help="base coalescing window in seconds; the plane grows it "
+        "under load and duty deadlines shrink it (core/cryptoplane.py)",
+    )
+    runp.add_argument(
+        "--crypto-plane-decode-workers",
+        type=int,
+        default=int(_env_default("crypto-plane-decode-workers", 4)),
+        help="decode/pack thread-pool size for the pipelined host "
+        "plane; 0 disables the pipeline (synchronous decode)",
+    )
+    runp.add_argument(
+        "--crypto-plane-prewarm",
+        choices=["auto", "on", "off"],
+        default=_env_default("crypto-plane-prewarm", "") or "auto",
+        help="compile the canonical duty shapes at startup: auto "
+        "pre-warms only on a TPU backend (CPU compiles take minutes)",
+    )
+    runp.add_argument(
         "--relay",
         default=_env_default("relay", ""),
         help="host:port of a charon-tpu relay for NAT fallback dials",
@@ -436,6 +457,13 @@ def cmd_run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.crypto_plane_prewarm not in ("auto", "on", "off"):
+        print(
+            f"--crypto-plane-prewarm {args.crypto_plane_prewarm!r}: "
+            "must be auto, on, or off",
+            file=sys.stderr,
+        )
+        return 2
 
     rc = _init_featureset(args)
     if rc:
@@ -473,6 +501,9 @@ def cmd_run(args) -> int:
         genesis_time=args.genesis_time,
         use_tpu_tbls=not args.no_tpu,
         crypto_plane=args.crypto_plane,
+        crypto_plane_window=args.crypto_plane_window,
+        crypto_plane_decode_workers=args.crypto_plane_decode_workers,
+        crypto_plane_prewarm=args.crypto_plane_prewarm,
         tracing_endpoint=args.tracing_endpoint,
         relay_addr=args.relay,
         fault_injection=args.fault_injection,

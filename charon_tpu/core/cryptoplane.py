@@ -1,5 +1,5 @@
 """Slot-tick coalescer: ONE sharded device program per flush for the
-whole node's concurrent crypto work.
+whole node's concurrent crypto work — with a pipelined host plane.
 
 The reference executes crypto per duty per signature on the CPU as calls
 arrive (ref: core/sigagg/sigagg.go:84-122 per-pubkey ThresholdAggregate +
@@ -12,9 +12,7 @@ VERDICT r3 next-step 3).
 
 SlotCoalescer is that batching point. Components submit work from the
 event loop and await results; submissions arriving within one coalescing
-window (default 20 ms — negligible against a 12 s slot, wide enough to
-catch the burst of partial-sig arrivals and duty expiries a slot tick
-produces) are merged:
+window are merged:
 
   * verify lanes (pk, root, sig) from ParSigEx inbound sets, the
     ValidatorAPI's pubshare checks, and SigAgg — concatenated into one
@@ -23,20 +21,49 @@ produces) are merged:
     the validator axis into one sharded recombine+verify step
     (`SlotCryptoPlane.recombine_host`).
 
-Device programs run on a worker thread so the event loop keeps serving
-QBFT/p2p traffic while the accelerator works. Decode failures (malformed
-compressed points) never reach the device: those lanes fail on host and
-are replaced by lane-0 padding in the batch.
+Pipeline (ISSUE 3): a flush passes through three host/device stages so
+host work overlaps device work and the event loop never runs bigint
+math:
+
+      submit ──► decode pool ──► window ──► pack (decode pool)
+                 (sqrt/h2c off                  │
+                  the loop)                     ▼
+                                        device lane (1 thread)
+
+  * DECODE — point decompression and hash-to-curve are pure-Python
+    bigint work (milliseconds per lane); submissions ship their items to
+    a sized ThreadPoolExecutor in chunks, so a slot-tick burst of N
+    partial sigs costs the loop microseconds instead of N×ms.
+  * PACK — once a window closes, array packing (Python ints -> numpy
+    limb arrays) and RLC randomness also run on the decode pool, so
+    window k may pack while the device still executes window k-1
+    (double buffering).
+  * DEVICE — a single serialized worker thread launches the compiled
+    program, preserving the device-contention and counter-integrity
+    guarantees of the original single-lane design.
+
+The coalescing window is adaptive: it grows toward `window_max` under
+sustained multi-job load (catch more of the burst per program) and
+decays back to the base once traffic thins; a submission carrying a duty
+deadline (core/deadline.SlotClock.duty_deadline) pulls the flush earlier
+so near-deadline work never waits out a grown window.
+
+Decode failures (malformed compressed points) never reach the device:
+those lanes fail on host and are replaced by lane-0 padding in the batch.
 
 The plane object only needs `t`, `verify_host`, and `recombine_host` —
 production passes `parallel.mesh.SlotCryptoPlane`; fast-tier tests pass
-a counting fake backed by the pure-python oracle.
+a counting fake backed by the pure-python oracle. Planes that also
+expose the packed two-stage API (`pack_verify_inputs`/`verify_packed`,
+`pack_inputs`/`recombine_packed`) get the pipelined pack stage; others
+fall back to the single-stage host API on the device lane.
 """
 
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+import time
+from dataclasses import dataclass
 from typing import Sequence
 
 from charon_tpu.crypto import g1g2
@@ -46,7 +73,8 @@ from charon_tpu.tbls import TblsError
 @dataclass
 class _VerifyJob:
     lanes: list  # [(pk_pt, msg_pt, sig_pt) | None] — None = host decode fail
-    fut: asyncio.Future = field(default=None)  # type: ignore[assignment]
+    fut: asyncio.Future
+    decode_delays: tuple = ()  # decode-pool queue delay per chunk
 
 
 @dataclass
@@ -58,7 +86,26 @@ class _RecombineJob:
     group_pks: list
     indices: list
     prefail: list  # [V] bool — True: fail without consulting the device
-    fut: asyncio.Future = field(default=None)  # type: ignore[assignment]
+    fut: asyncio.Future
+    decode_delays: tuple = ()
+
+
+@dataclass(frozen=True)
+class FlushStats:
+    """Per-flush pipeline observability, delivered to `stats_hook` from
+    the device worker thread (thread-safe sinks only)."""
+
+    jobs: int
+    lanes: int
+    flush_seconds: float  # device-lane wall clock (pack excluded)
+    window: float  # adaptive window in force when the flush armed
+    inflight: int  # device-lane depth at submit (1 when single-buffered
+    # idle traffic; >= 2 means this flush double-buffered behind an
+    # in-flight program)
+    pad_lanes: int | None  # bucket-padding lanes shipped (packed path)
+    padded_lanes: int | None  # total lanes after bucket padding
+    decode_queue_seconds: tuple[float, ...]  # decode-pool queue delays
+    fallback: bool = False  # served by the python-spec rung
 
 
 def _decode_pubkey(pk: bytes):
@@ -82,22 +129,66 @@ def _msg_point(root: bytes):
     return _cached_msg_point(root)
 
 
+def _decode_verify_lane(item):
+    """(pk, root, sig) bytes -> decoded point triple, or None on any
+    malformed encoding (the lane fails on host, never ships)."""
+    pk, root, sig = item
+    try:
+        return (_decode_pubkey(pk), _msg_point(root), _decode_sig(sig))
+    except (TblsError, ValueError):
+        return None
+
+
 class SlotCoalescer:
     """Merges concurrent verify / recombine submissions into single
     sharded device programs (see module docstring).
 
-    window: seconds to wait after the first submission before flushing.
+    window: base seconds to wait after the first submission before
+    flushing; the adaptive controller moves the live window within
+    [window, window_max] under load and deadlines cap it down to
+    window_min.
+    decode_workers: decode/pack pool size; 0 disables the pipeline
+    entirely (decode runs synchronously on the caller — the pre-pipeline
+    path, kept for A/B benching). The pool is created lazily on first
+    use, so an idle or disabled plane owns no threads.
     flushes / coalesced_flushes / lanes_flushed: observability counters
     (exported as node metrics by app/run.py).
     """
 
+    # decode-pool chunking: large enough to amortize executor submission,
+    # small enough to spread one burst across the workers
+    DECODE_CHUNK = 16
+    # adaptive window controller: grow when a flush coalesced >=2 jobs or
+    # carried a burst, decay back to the base window otherwise
+    WINDOW_GROW = 1.5
+    WINDOW_DECAY = 0.75
+    GROW_LANES = 64
+    # graded deadline shrink: spend at most this fraction of the time
+    # remaining before the duty deadline on coalescing — with a 60 s
+    # expiry window the cap is inert (plenty of time), but a retrying
+    # near-expiry submission (seconds left) flushes in milliseconds
+    # instead of waiting out a load-grown window
+    DEADLINE_WINDOW_FRAC = 0.01
+
     def __init__(
-        self, plane, window: float = 0.02, metrics_hook=None, plane_factory=None
+        self,
+        plane,
+        window: float = 0.02,
+        metrics_hook=None,
+        plane_factory=None,
+        window_min: float = 0.002,
+        window_max: float = 0.08,
+        decode_workers: int = 4,
+        stats_hook=None,
+        trace: bool = False,
     ):
         import concurrent.futures
 
         self.plane = plane
         self.window = window
+        self.window_min = min(window_min, window)
+        self.window_max = max(window_max, window)
+        self.decode_workers = decode_workers
         # msm-off degradation rung (mirrors tbls/tpu_impl._rlc_guarded):
         # a device/compile failure in the newest kernel family is not a
         # crypto verdict. plane_factory() rebuilds the plane after the
@@ -106,48 +197,156 @@ class SlotCoalescer:
         # without a rebuild would re-run the identical failed executable).
         self._plane_factory = plane_factory
         self._degraded = False
+        self._closed = False
         self._verify_q: list[_VerifyJob] = []
         self._recombine_q: list[_RecombineJob] = []
         self._flush_task: asyncio.Task | None = None
-        # single-threaded: a second window can elapse while a device
-        # program is still running; its flush must QUEUE behind the
-        # first, not race it (device contention + counter integrity)
+        self._flush_at: float = 0.0  # monotonic flush target of armed task
+        self._flush_wake = asyncio.Event()
+        self._queue_deadline: float | None = None  # monotonic, min over jobs
+        # submissions mid-decode (closing windows wait for these)
+        self._decode_tickets: set[asyncio.Future] = set()
+        self._window_current = window
+        # single-threaded device lane: a second window can elapse while a
+        # device program is still running; its flush must QUEUE behind
+        # the first, not race it (device contention + counter integrity)
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="crypto-plane"
         )
+        # decode/pack pool — created lazily so a coalescer that never
+        # sees traffic (or runs with decode_workers=0) owns no threads
+        self._decode_pool: concurrent.futures.ThreadPoolExecutor | None = None
         self.flushes = 0
         self.coalesced_flushes = 0  # flushes that merged >= 2 jobs
         self.lanes_flushed = 0
         self.host_fallback_flushes = 0  # served by the python-spec rung
+        self.pack_fallbacks = 0  # pack-stage failures (single-stage flush)
+        self.pad_lanes_flushed = 0  # bucket-padding lanes shipped
+        self.overlapped_flushes = 0  # submitted while the device was busy
+        self._inflight = 0  # flushes inside the device lane (incl. queued)
+        self.max_inflight = 0
         # called after each flush with (jobs, lanes) — thread-safe
         # counters only (runs on the device worker thread)
         self.metrics_hook = metrics_hook
+        # richer per-flush pipeline stats (FlushStats) — same threading
+        # contract as metrics_hook
+        self.stats_hook = stats_hook
+        # trace=True records (start, end) monotonic spans per pipeline
+        # stage for bench_hostplane.py's overlap measurement
+        self.trace = trace
+        self.decode_spans: list[tuple[float, float]] = []
+        self.pack_spans: list[tuple[float, float]] = []
+        self.device_spans: list[tuple[float, float]] = []
 
     @property
     def t(self) -> int:
         return self.plane.t
 
+    @property
+    def current_window(self) -> float:
+        """The adaptive coalescing window currently in force."""
+        return self._window_current
+
+    def close(self) -> None:
+        """Shut down the worker pools (idempotent). Late flushes fail
+        their waiters fast instead of tripping the degradation rung."""
+        self._closed = True
+        self._executor.shutdown(wait=False)
+        if self._decode_pool is not None:
+            self._decode_pool.shutdown(wait=False)
+            self._decode_pool = None
+
+    # -- decode pool (host stage 1) ---------------------------------------
+
+    def _pool(self):
+        if self._decode_pool is None:
+            import concurrent.futures
+
+            self._decode_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.decode_workers,
+                thread_name_prefix="crypto-decode",
+            )
+        return self._decode_pool
+
+    async def _map_offloop(self, fn, items: list):
+        """Apply `fn` per item with the bigint work OFF the event loop:
+        items ship to the decode pool in DECODE_CHUNK chunks (batched
+        submission — one executor hop per chunk, not per lane). Returns
+        (results, per-chunk queue delays) — the delays travel with the
+        job so each flush's stats report ITS OWN decode queueing, not
+        whatever the concurrent next window happens to be decoding.
+        With the pool disabled the map runs inline on the caller — the
+        pre-pipeline synchronous path bench_hostplane.py baselines."""
+        # closed: inline decode instead of resurrecting a pool nobody
+        # will shut down (the flush fails these waiters fast anyway)
+        if self.decode_workers <= 0 or self._closed:
+            if self.trace:
+                t0 = time.monotonic()
+                out = [fn(it) for it in items]
+                self.decode_spans.append((t0, time.monotonic()))
+                return out, ()
+            return [fn(it) for it in items], ()
+        loop = asyncio.get_running_loop()
+        pool = self._pool()
+        submitted = time.monotonic()
+
+        def run_chunk(chunk):
+            t0 = time.monotonic()
+            out = [fn(it) for it in chunk]
+            if self.trace:
+                self.decode_spans.append((t0, time.monotonic()))
+            return out, t0 - submitted
+
+        chunks = [
+            items[i : i + self.DECODE_CHUNK]
+            for i in range(0, len(items), self.DECODE_CHUNK)
+        ]
+        parts = await asyncio.gather(
+            *(loop.run_in_executor(pool, run_chunk, c) for c in chunks)
+        )
+        return (
+            [lane for part, _ in parts for lane in part],
+            tuple(delay for _, delay in parts),
+        )
+
     # -- submission APIs (event-loop side) --------------------------------
 
     async def verify(
-        self, items: Sequence[tuple[bytes, bytes, bytes]]
+        self,
+        items: Sequence[tuple[bytes, bytes, bytes]],
+        deadline: float | None = None,
     ) -> list[bool]:
         """Batch-verify (pubkey_bytes, signing_root, sig_bytes) lanes.
-        Returns per-lane validity; malformed encodings are False."""
+        Returns per-lane validity; malformed encodings are False.
+        deadline: optional absolute wall-clock (time.time) duty deadline
+        — pulls the flush earlier when the window would overshoot it."""
         if not items:
             return []
-        lanes: list = []
-        for pk, root, sig in items:
-            try:
-                lanes.append(
-                    (_decode_pubkey(pk), _msg_point(root), _decode_sig(sig))
-                )
-            except (TblsError, ValueError):
-                lanes.append(None)
-        job = _VerifyJob(lanes=lanes)
-        job.fut = asyncio.get_running_loop().create_future()
-        self._verify_q.append(job)
-        self._arm()
+        loop = asyncio.get_running_loop()
+        # decode ticket: an armed flush whose window closes while this
+        # submission is still decoding WAITS for it — otherwise a burst
+        # whose cold-cache decode outlasts the window would split into
+        # one device program per submission (the anti-coalescing bug)
+        ticket = loop.create_future()
+        self._decode_tickets.add(ticket)
+        try:
+            lanes, delays = await self._map_offloop(
+                _decode_verify_lane, list(items)
+            )
+            job = _VerifyJob(
+                lanes=lanes,
+                fut=loop.create_future(),
+                decode_delays=delays,
+            )
+            self._verify_q.append(job)
+            self._arm(deadline)
+        finally:
+            # resolve AFTER the append above (same synchronous block):
+            # the waiting flush wakes only on the next scheduler turn,
+            # so the job is guaranteed to be in the collected queue
+            self._decode_tickets.discard(ticket)
+            if not ticket.done():
+                ticket.set_result(None)
         return await job.fut
 
     async def recombine(
@@ -157,49 +356,61 @@ class SlotCoalescer:
         partials: Sequence[Sequence[bytes]],
         group_pks: Sequence[bytes],
         indices: Sequence[Sequence[int]],
+        deadline: float | None = None,
     ) -> tuple[list[bytes | None], list[bool]]:
         """Threshold-recombine + verify a duty's [V, t] workload.
         Returns ([V] group signature bytes or None, [V] ok flags)."""
         if not roots:
             return [], []
         t = self.plane.t
-        ps_rows, msg_pts, sig_rows, gpk_pts, idx_rows, prefail = (
-            [], [], [], [], [], []
-        )
-        for ps_row, root, sig_row, gpk, idx_row in zip(
-            pubshares, roots, partials, group_pks, indices
-        ):
+
+        def decode_row(row):
+            ps_row, root, sig_row, gpk, idx_row = row
             try:
                 if len(sig_row) != t or len(ps_row) != t or len(idx_row) != t:
                     raise TblsError(f"need exactly t={t} partials per lane")
                 if any(i <= 0 for i in idx_row):
                     raise TblsError("share indices are 1-based")
-                ps_rows.append([_decode_pubkey(p) for p in ps_row])
-                sig_rows.append([_decode_sig(s) for s in sig_row])
-                gpk_pts.append(_decode_pubkey(gpk))
-                msg_pts.append(_msg_point(root))
-                idx_rows.append(list(idx_row))
-                prefail.append(False)
+                return (
+                    [_decode_pubkey(p) for p in ps_row],
+                    _msg_point(root),
+                    [_decode_sig(s) for s in sig_row],
+                    _decode_pubkey(gpk),
+                    list(idx_row),
+                    False,
+                )
             except (TblsError, ValueError):
-                # placeholder row (patched to lane data below) — never
-                # consulted; the lane is failed on host
-                ps_rows.append(None)
-                sig_rows.append(None)
-                gpk_pts.append(None)
-                msg_pts.append(None)
-                idx_rows.append(None)
-                prefail.append(True)
-        job = _RecombineJob(
-            pubshares=ps_rows,
-            msgs=msg_pts,
-            partials=sig_rows,
-            group_pks=gpk_pts,
-            indices=idx_rows,
-            prefail=prefail,
-        )
-        job.fut = asyncio.get_running_loop().create_future()
-        self._recombine_q.append(job)
-        self._arm()
+                # prefail row — skipped during batch assembly (never
+                # shipped to the device); the lane is failed on host
+                return (None, None, None, None, None, True)
+
+        loop = asyncio.get_running_loop()
+        ticket = loop.create_future()  # see verify() for the contract
+        self._decode_tickets.add(ticket)
+        try:
+            rows, delays = await self._map_offloop(
+                decode_row,
+                list(zip(pubshares, roots, partials, group_pks, indices)),
+            )
+            ps_rows, msg_pts, sig_rows, gpk_pts, idx_rows, prefail = (
+                [list(col) for col in zip(*rows)]
+            )
+            job = _RecombineJob(
+                pubshares=ps_rows,
+                msgs=msg_pts,
+                partials=sig_rows,
+                group_pks=gpk_pts,
+                indices=idx_rows,
+                prefail=prefail,
+                fut=loop.create_future(),
+                decode_delays=delays,
+            )
+            self._recombine_q.append(job)
+            self._arm(deadline)
+        finally:
+            self._decode_tickets.discard(ticket)
+            if not ticket.done():
+                ticket.set_result(None)
         sigs_pts, oks = await job.fut
         return (
             [
@@ -211,53 +422,150 @@ class SlotCoalescer:
 
     # -- flush machinery ---------------------------------------------------
 
-    def _arm(self) -> None:
+    def _arm(self, deadline: float | None = None) -> None:
+        now = time.monotonic()
+        if deadline is not None:
+            # duty deadlines are wall-clock (core/deadline.SlotClock);
+            # convert to the monotonic base the flush timer runs on
+            dl_mono = now + max(0.0, deadline - time.time())
+            if self._queue_deadline is None or dl_mono < self._queue_deadline:
+                self._queue_deadline = dl_mono
+        target = now + self._window_current
+        if self._queue_deadline is not None:
+            # graded shrink toward the deadline, never below window_min
+            # (give concurrent submissions a beat to coalesce regardless)
+            remaining = self._queue_deadline - now
+            cap = max(
+                self.window_min, remaining * self.DEADLINE_WINDOW_FRAC
+            )
+            target = min(target, now + cap)
         if self._flush_task is None or self._flush_task.done():
+            self._flush_at = target
+            # fresh Event per armed task: asyncio primitives bind to the
+            # running loop on first use, and one coalescer may serve
+            # several asyncio.run() lifetimes (tests, CLI tools)
+            self._flush_wake = asyncio.Event()
             self._flush_task = asyncio.create_task(self._flush_after_window())
+        elif target < self._flush_at:
+            # a tighter deadline arrived while the window timer sleeps:
+            # pull the armed flush earlier (never later)
+            self._flush_at = target
+            self._flush_wake.set()
 
     async def _flush_after_window(self) -> None:
-        await asyncio.sleep(self.window)
+        while True:
+            self._flush_wake.clear()
+            remaining = self._flush_at - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(
+                    self._flush_wake.wait(), timeout=remaining
+                )
+            except asyncio.TimeoutError:
+                pass
+        # submissions still mid-decode when the window closed join this
+        # flush (ONE snapshot — later arrivals take the next window, so
+        # sustained load cannot defer the flush unboundedly)
+        pending = list(self._decode_tickets)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
         vq, self._verify_q = self._verify_q, []
         rq, self._recombine_q = self._recombine_q, []
-        # new submissions from here on arm a fresh flush task
+        # new submissions from here on arm a fresh flush task — its
+        # decode/pack stages overlap this flush's device stage
         self._flush_task = None
+        self._queue_deadline = None
         if not vq and not rq:
             return
+        if self._closed:
+            # shutdown raced a late submission: fail the waiters fast —
+            # a closed-executor RuntimeError must not masquerade as a
+            # device failure and burn the msm-off rung
+            for job in [*vq, *rq]:
+                if not job.fut.done():
+                    job.fut.set_exception(TblsError("crypto plane closed"))
+            return
+        window_used = self._window_current
+        self._adapt_window(vq, rq)
         loop = asyncio.get_running_loop()
-        try:
-            vres, rres = await loop.run_in_executor(
-                self._executor, self._run_device, vq, rq
-            )
-        except Exception as e:  # noqa: BLE001 — degrade, else fail waiters
-            retried = await self._degrade_and_retry(vq, rq, e)
-            if retried is None:
-                # last rung: the pure-python spec oracle. Orders of
-                # magnitude slower than the device, but a wedged
-                # accelerator must cost latency, not the duty — the
-                # signing plane stays live on the degraded backend
-                # (ISSUE: degrade TPU -> native -> python-spec).
-                try:
-                    retried = await loop.run_in_executor(
-                        self._executor, self._run_host_oracle, vq, rq
-                    )
-                    self.host_fallback_flushes += 1
+        # host stage 2: pack the batch on the decode pool so the device
+        # lane (possibly still executing the previous window) is never
+        # blocked on numpy conversion of Python ints
+        packed = None
+        if self.decode_workers > 0 and self._plane_has_packed_api():
+            try:
+                packed = await loop.run_in_executor(
+                    self._pool(), self._pack_flush, vq, rq
+                )
+            except Exception as e:  # noqa: BLE001 — pack bug: the
+                # single-stage path repacks on the device lane, which
+                # still serves the flush but silently un-pipelines it —
+                # count + warn so a persistent pack failure is visible
+                packed = None
+                self.pack_fallbacks += 1
+                if self.pack_fallbacks == 1 or self.pack_fallbacks % 100 == 0:
                     from charon_tpu.app import log
 
                     log.warn(
-                        "crypto plane flush served by python-spec "
-                        "host fallback",
+                        "crypto plane pack stage failed; flushing "
+                        "single-stage on the device lane",
                         topic="cryptoplane",
-                        rung="host-oracle",
+                        count=self.pack_fallbacks,
                         err=f"{type(e).__name__}: {str(e)[:160]}",
                     )
-                except Exception:  # noqa: BLE001 — rungs exhausted
-                    for job in [*vq, *rq]:
-                        if not job.fut.done():
-                            job.fut.set_exception(
-                                TblsError(f"crypto plane flush failed: {e}")
-                            )
-                    return
-            vres, rres = retried
+        inflight = self._inflight + 1
+        self._inflight = inflight
+        self.max_inflight = max(self.max_inflight, inflight)
+        if inflight >= 2:
+            self.overlapped_flushes += 1
+        try:
+            try:
+                vres, rres = await loop.run_in_executor(
+                    self._executor,
+                    self._run_device,
+                    vq,
+                    rq,
+                    packed,
+                    window_used,
+                    inflight,
+                )
+            except Exception as e:  # noqa: BLE001 — degrade or fail waiters
+                retried = await self._degrade_and_retry(
+                    vq, rq, e, window_used, inflight
+                )
+                if retried is None:
+                    # last rung: the pure-python spec oracle. Orders of
+                    # magnitude slower than the device, but a wedged
+                    # accelerator must cost latency, not the duty — the
+                    # signing plane stays live on the degraded backend
+                    # (ISSUE: degrade TPU -> native -> python-spec).
+                    try:
+                        retried = await loop.run_in_executor(
+                            self._executor, self._run_host_oracle, vq, rq
+                        )
+                        self.host_fallback_flushes += 1
+                        from charon_tpu.app import log
+
+                        log.warn(
+                            "crypto plane flush served by python-spec "
+                            "host fallback",
+                            topic="cryptoplane",
+                            rung="host-oracle",
+                            err=f"{type(e).__name__}: {str(e)[:160]}",
+                        )
+                    except Exception:  # noqa: BLE001 — rungs exhausted
+                        for job in [*vq, *rq]:
+                            if not job.fut.done():
+                                job.fut.set_exception(
+                                    TblsError(
+                                        f"crypto plane flush failed: {e}"
+                                    )
+                                )
+                        return
+                vres, rres = retried
+        finally:
+            self._inflight -= 1
         for job, res in zip(vq, vres):
             if not job.fut.done():
                 job.fut.set_result(res)
@@ -265,7 +573,203 @@ class SlotCoalescer:
             if not job.fut.done():
                 job.fut.set_result(res)
 
-    async def _degrade_and_retry(self, vq, rq, err):
+    def _adapt_window(self, vq, rq) -> None:
+        """Sustained load (multi-job windows or lane bursts) grows the
+        window toward window_max — each program catches more of the
+        burst; light traffic decays it back to the base so single duties
+        never wait out a grown window."""
+        jobs = len(vq) + len(rq)
+        lanes = sum(len(j.lanes) for j in vq) + sum(len(j.msgs) for j in rq)
+        if jobs >= 2 or lanes >= self.GROW_LANES:
+            self._window_current = min(
+                self.window_max, self._window_current * self.WINDOW_GROW
+            )
+        else:
+            self._window_current = max(
+                self.window, self._window_current * self.WINDOW_DECAY
+            )
+
+    def _plane_has_packed_api(self) -> bool:
+        return all(
+            hasattr(self.plane, name)
+            for name in (
+                "pack_verify_inputs",
+                "make_lane_rand",
+                "verify_packed",
+                "pack_inputs",
+                "make_rand",
+                "recombine_packed",
+            )
+        )
+
+    @staticmethod
+    def _flat_verify_lanes(vq: list[_VerifyJob]) -> list:
+        return [lane for job in vq for lane in job.lanes if lane is not None]
+
+    @staticmethod
+    def _live_recombine_rows(rq: list[_RecombineJob]):
+        ps, msg, sig, gpk, idx = [], [], [], [], []
+        for job in rq:
+            for i in range(len(job.msgs)):
+                if not job.prefail[i]:
+                    ps.append(job.pubshares[i])
+                    msg.append(job.msgs[i])
+                    sig.append(job.partials[i])
+                    gpk.append(job.group_pks[i])
+                    idx.append(job.indices[i])
+        return ps, msg, sig, gpk, idx
+
+    def _pack_flush(self, vq, rq):
+        """Decode-pool thread: array packing + RLC randomness for the
+        whole flush. Returns (vpack, rpack) for _run_device's packed
+        fast path — this is the half of the old verify_host/
+        recombine_host work that does NOT need the device lane."""
+        t0 = time.monotonic()
+        plane = self.plane
+        vpack = None
+        flat = self._flat_verify_lanes(vq)
+        if flat:
+            pks, msgs, sigs = zip(*flat)
+            vpack = (
+                plane.pack_verify_inputs(pks, msgs, sigs),
+                plane.make_lane_rand(len(flat)),
+                len(flat),
+            )
+        rpack = None
+        ps, msg, sig, gpk, idx = self._live_recombine_rows(rq)
+        if msg:
+            rpack = (
+                plane.pack_inputs(ps, msg, sig, gpk, idx),
+                plane.make_rand(len(msg)),
+                len(msg),
+            )
+        if self.trace:
+            self.pack_spans.append((t0, time.monotonic()))
+        return vpack, rpack
+
+    # -- device side (worker thread) --------------------------------------
+
+    def _run_device(
+        self,
+        vq: list[_VerifyJob],
+        rq: list[_RecombineJob],
+        packed=None,
+        window_used: float = 0.0,
+        inflight: int = 1,
+    ):
+        # counters update only AFTER both stages succeed: a failed flush
+        # that the degrade rung retries must not double-count its lanes
+        t0 = time.monotonic()
+        vpack, rpack = packed if packed is not None else (None, None)
+        lanes = 0
+        pad_lanes = padded_lanes = 0 if packed is not None else None
+        vres: list[list[bool]] = []
+        if vq:
+            if vpack is not None:
+                # flat lane count came with the pack — don't re-flatten
+                # on the serialized device lane
+                arrays, rand, n = vpack
+                oks = iter(self.plane.verify_packed(arrays, rand, n))
+                shipped = self._packed_lane_count(arrays)
+                pad_lanes += shipped - n
+                padded_lanes += shipped
+            else:
+                flat = self._flat_verify_lanes(vq)
+                n = len(flat)
+                if flat:
+                    pks, msgs, sigs = zip(*flat)
+                    oks = iter(self.plane.verify_host(pks, msgs, sigs))
+                else:
+                    oks = iter(())
+            for job in vq:
+                vres.append(
+                    [
+                        next(oks) if lane is not None else False
+                        for lane in job.lanes
+                    ]
+                )
+            lanes += n
+        rres: list[tuple[list, list[bool]]] = []
+        if rq:
+            if rpack is not None:
+                arrays, rand, v = rpack
+                out_sigs, out_oks = self.plane.recombine_packed(
+                    arrays, rand, v
+                )
+                shipped = self._packed_lane_count(arrays)
+                pad_lanes += shipped - v
+                padded_lanes += shipped
+            else:
+                ps, msg, sig, gpk, idx = self._live_recombine_rows(rq)
+                if msg:
+                    out_sigs, out_oks = self.plane.recombine_host(
+                        ps, msg, sig, gpk, idx
+                    )
+                else:
+                    out_sigs, out_oks = [], []
+            it_sig, it_ok = iter(out_sigs), iter(out_oks)
+            live_rows = 0
+            for job in rq:
+                sigs_pts: list = []
+                oks: list[bool] = []
+                for pf in job.prefail:
+                    if pf:
+                        sigs_pts.append(None)
+                        oks.append(False)
+                    else:
+                        sigs_pts.append(next(it_sig))
+                        oks.append(next(it_ok))
+                        live_rows += 1
+                rres.append((sigs_pts, oks))
+            lanes += live_rows
+        if self.trace:
+            self.device_spans.append((t0, time.monotonic()))
+        self._account_flush(
+            vq,
+            rq,
+            lanes,
+            FlushStats(
+                jobs=len(vq) + len(rq),
+                lanes=lanes,
+                flush_seconds=time.monotonic() - t0,
+                window=window_used,
+                inflight=inflight,
+                pad_lanes=pad_lanes,
+                padded_lanes=padded_lanes,
+                decode_queue_seconds=self._job_decode_delays(vq, rq),
+            ),
+        )
+        return vres, rres
+
+    @staticmethod
+    def _packed_lane_count(arrays) -> int:
+        """Leading-axis size of a packed batch = lanes after bucket
+        padding (the live mask is the last element of every pack)."""
+        live = arrays[-1]
+        return int(live.shape[0])
+
+    @staticmethod
+    def _job_decode_delays(vq, rq) -> tuple[float, ...]:
+        """Decode-pool queue delays of exactly THIS flush's jobs."""
+        return tuple(
+            delay for job in [*vq, *rq] for delay in job.decode_delays
+        )
+
+    def _account_flush(self, vq, rq, lanes: int, stats: FlushStats) -> None:
+        self.lanes_flushed += lanes
+        self.flushes += 1
+        if stats.pad_lanes:
+            self.pad_lanes_flushed += stats.pad_lanes
+        if len(vq) + len(rq) >= 2:
+            self.coalesced_flushes += 1
+        if self.metrics_hook is not None:
+            self.metrics_hook(len(vq) + len(rq), lanes)
+        if self.stats_hook is not None:
+            self.stats_hook(stats)
+
+    async def _degrade_and_retry(
+        self, vq, rq, err, window_used: float = 0.0, inflight: int = 1
+    ):
         """One-shot msm-off rung: flip the MSM family off, rebuild the
         plane so its programs re-trace, and retry the SAME batch on the
         per-lane path. Returns (vres, rres) or None if the rung is spent
@@ -285,7 +789,8 @@ class SlotCoalescer:
             # (ADVICE r4: gate the rung on device/compile error types)
             return None
         if (
-            self._degraded
+            self._closed
+            or self._degraded
             or not MSM.msm_active()
             or self._plane_factory is None
         ):
@@ -310,7 +815,7 @@ class SlotCoalescer:
             # jax.devices()/compilation, which can block for minutes on
             # a wedged device claim
             self.plane = self._plane_factory()
-            return self._run_device(vq, rq)
+            return self._run_device(vq, rq, None, window_used, inflight)
 
         try:
             loop = asyncio.get_running_loop()
@@ -318,67 +823,38 @@ class SlotCoalescer:
         except Exception:  # noqa: BLE001 — rung spent; caller fails waiters
             return None
 
-    # -- device side (worker thread) --------------------------------------
+    # -- pre-warm (startup) ------------------------------------------------
 
-    def _run_device(self, vq: list[_VerifyJob], rq: list[_RecombineJob]):
-        # counters update only AFTER both stages succeed: a failed flush
-        # that the degrade rung retries must not double-count its lanes
-        lanes = 0
-        vres: list[list[bool]] = []
-        if vq:
-            flat: list = []
-            for job in vq:
-                flat.extend(l for l in job.lanes if l is not None)
-            if flat:
-                pks, msgs, sigs = zip(*flat)
-                oks = iter(self.plane.verify_host(pks, msgs, sigs))
-            else:
-                oks = iter(())
-            for job in vq:
-                vres.append(
-                    [
-                        next(oks) if l is not None else False
-                        for l in job.lanes
-                    ]
-                )
-            lanes += len(flat)
-        rres: list[tuple[list, list[bool]]] = []
-        if rq:
-            ps, msg, sig, gpk, idx = [], [], [], [], []
-            for job in rq:
-                for i in range(len(job.msgs)):
-                    if not job.prefail[i]:
-                        ps.append(job.pubshares[i])
-                        msg.append(job.msgs[i])
-                        sig.append(job.partials[i])
-                        gpk.append(job.group_pks[i])
-                        idx.append(job.indices[i])
-            if msg:
-                out_sigs, out_oks = self.plane.recombine_host(
-                    ps, msg, sig, gpk, idx
-                )
-            else:
-                out_sigs, out_oks = [], []
-            it_sig, it_ok = iter(out_sigs), iter(out_oks)
-            for job in rq:
-                sigs_pts: list = []
-                oks: list[bool] = []
-                for pf in job.prefail:
-                    if pf:
-                        sigs_pts.append(None)
-                        oks.append(False)
-                    else:
-                        sigs_pts.append(next(it_sig))
-                        oks.append(next(it_ok))
-                rres.append((sigs_pts, oks))
-            lanes += len(msg)
-        self.lanes_flushed += lanes
-        self.flushes += 1
-        if len(vq) + len(rq) >= 2:
-            self.coalesced_flushes += 1
-        if self.metrics_hook is not None:
-            self.metrics_hook(len(vq) + len(rq), lanes)
-        return vres, rres
+    async def prewarm(
+        self,
+        verify_lanes: Sequence[int] | None = None,
+        recombine_lanes: Sequence[int] | None = None,
+    ) -> list:
+        """Trace + compile the canonical duty-path shapes on the device
+        lane so the first live slot never eats a cold pairing compile.
+        None defers to the plane's bucket-ladder defaults (smallest
+        bucket + canonical burst shapes). Runs through the same
+        serialized executor as flushes (a live flush queues behind the
+        compile instead of racing it). Returns the plane's
+        [(kind, lanes, seconds)] compile report; [] when the plane has
+        no prewarm support (test fakes)."""
+        fn = getattr(self.plane, "prewarm", None)
+        if fn is None:
+            return []
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor,
+            lambda: fn(
+                verify_lanes=(
+                    None if verify_lanes is None else tuple(verify_lanes)
+                ),
+                recombine_lanes=(
+                    None
+                    if recombine_lanes is None
+                    else tuple(recombine_lanes)
+                ),
+            ),
+        )
 
     # -- python-spec host fallback (worker thread) -------------------------
 
@@ -401,6 +877,7 @@ class SlotCoalescer:
         no jitted programs — the rung below every accelerator failure."""
         from charon_tpu.crypto import shamir
 
+        t0 = time.monotonic()
         lanes = 0
         vres: list[list[bool]] = []
         for job in vq:
@@ -431,10 +908,20 @@ class SlotCoalescer:
                 oks.append(ok)
                 lanes += 1
             rres.append((sigs_pts, oks))
-        self.lanes_flushed += lanes
-        self.flushes += 1
-        if len(vq) + len(rq) >= 2:
-            self.coalesced_flushes += 1
-        if self.metrics_hook is not None:
-            self.metrics_hook(len(vq) + len(rq), lanes)
+        self._account_flush(
+            vq,
+            rq,
+            lanes,
+            FlushStats(
+                jobs=len(vq) + len(rq),
+                lanes=lanes,
+                flush_seconds=time.monotonic() - t0,
+                window=self._window_current,
+                inflight=self._inflight,
+                pad_lanes=None,
+                padded_lanes=None,
+                decode_queue_seconds=self._job_decode_delays(vq, rq),
+                fallback=True,
+            ),
+        )
         return vres, rres

@@ -84,11 +84,13 @@ class Eth2Verifier:
         pubshares_by_idx: dict[int, dict[PubKey, bytes]],
         slots_per_epoch: int = 32,
         plane: object | None = None,  # core.cryptoplane.SlotCoalescer
+        clock: SlotClock | None = None,  # duty deadlines for the plane
     ) -> None:
         self.fork = fork
         self.pubshares_by_idx = pubshares_by_idx
         self.slots_per_epoch = slots_per_epoch
         self.plane = plane
+        self.clock = clock
 
     def _items(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]):
         items = []
@@ -114,7 +116,14 @@ class Eth2Verifier:
         if self.plane is None:
             return self.verify(duty, signed_set)
         items = self._items(duty, signed_set)
-        return items is not None and all(await self.plane.verify(items))
+        if items is None:
+            return False
+        kwargs = {}
+        if self.clock is not None:
+            # near-deadline sets shrink the coalescing window instead of
+            # waiting out a load-grown one (core/cryptoplane adaptive)
+            kwargs["deadline"] = self.clock.duty_deadline(duty)
+        return all(await self.plane.verify(items, **kwargs))
 
 
 class MemTransport:

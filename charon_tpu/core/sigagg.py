@@ -40,6 +40,10 @@ class SigAgg:
     slots_per_epoch: int = 32
     plane: object | None = None  # core.cryptoplane.SlotCoalescer
     pubshares_by_idx: Mapping[int, Mapping[PubKey, bytes]] | None = None
+    # optional core.deadline.SlotClock: plane submissions carry the
+    # duty's expiry so the coalescer's adaptive window shrinks instead
+    # of overshooting a near-deadline aggregation
+    clock: object | None = None
 
     def __post_init__(self) -> None:
         self._subs: list[AggSub] = []
@@ -128,8 +132,11 @@ class SigAgg:
             sig_rows.append([pmap[i] for i in idx])
             gpks.append(pubkey_to_bytes(pubkey))
             idx_rows.append(idx)
+        kwargs = {}
+        if self.clock is not None:
+            kwargs["deadline"] = self.clock.duty_deadline(duty)
         group_sigs, ok = await self.plane.recombine(
-            ps_rows, roots, sig_rows, gpks, idx_rows
+            ps_rows, roots, sig_rows, gpks, idx_rows, **kwargs
         )
         bad = [str(pk) for pk, o in zip(pubkeys, ok) if not o]
         if bad:

@@ -30,10 +30,55 @@ from charon_tpu.ops import pairing as DP
 from charon_tpu.ops.limb import ModCtx
 
 
-def _next_pow2(n: int) -> int:
+def next_pow2(n: int) -> int:
     """Padded batch size: next power of two, minimum 4 — so every small
     call shares one compiled program (kernel-shape discipline)."""
     return max(4, 1 << max(0, (n - 1)).bit_length())
+
+
+_next_pow2 = next_pow2  # internal alias (pre-bucketing name)
+
+
+def bucket_lanes(n: int, multiple: int = 1) -> int:
+    """THE shape-bucket ladder every batched entry point pads to:
+    `multiple * pow2(ceil(n / multiple))`.
+
+    `multiple` is the mesh shard count for sharded planes (the padded
+    batch must split evenly over shards) and 1 for single-device
+    engines, where this reduces to plain next_pow2 with its 4-lane
+    floor. Sharded planes use a per-shard floor of 1 instead — the
+    shard count is already their batch floor, so small slot workloads
+    keep the cheap `multiple`-lane program. Using one ladder for
+    BlsEngine AND the coalescer's sharded flushes keeps the jit cache
+    bounded at O(log max_batch) compiled programs per kernel family —
+    arbitrary flush sizes land on pre-declared bucket shapes instead of
+    compiling per size (ISSUE 3: unify shape bucketing)."""
+    if multiple <= 0:
+        raise ValueError("multiple must be positive")
+    if multiple == 1:
+        return next_pow2(n)
+    per_shard = -(-n // multiple)
+    return multiple * (1 << max(0, (per_shard - 1)).bit_length())
+
+
+# Every jitted kernel this module builds registers here so tests (and
+# operators via bench tooling) can measure COMPILED PROGRAM counts —
+# the regression signal for unbounded jit-cache growth when a caller
+# bypasses the bucket ladder.
+_JIT_KERNELS: list = []
+
+
+def _jit_kernel(fn):
+    jitted = jax.jit(fn)
+    _JIT_KERNELS.append(jitted)
+    return jitted
+
+
+def jit_cache_size() -> int:
+    """Total compiled-program count across this module's live jitted
+    kernels. Bounded by (kernel families) x (bucket-ladder shapes) —
+    asserted in tests/test_hostplane.py across random-size flushes."""
+    return sum(k._cache_size() for k in _JIT_KERNELS)
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +151,7 @@ def clear_kernel_caches() -> None:
         fn = getattr(mod, name)
         if callable(fn) and hasattr(fn, "cache_clear"):
             fn.cache_clear()
+    _JIT_KERNELS.clear()  # dropped with their lru entries — don't leak
 
 
 def threshold_recombine(ctx: ModCtx, fr_ctx: ModCtx, t: int, sig_affine, idx):
@@ -130,7 +176,7 @@ def threshold_recombine(ctx: ModCtx, fr_ctx: ModCtx, t: int, sig_affine, idx):
 
 @functools.lru_cache(maxsize=None)
 def _threshold_agg_kernel(ctx: ModCtx, fr_ctx: ModCtx, t: int):
-    return jax.jit(
+    return _jit_kernel(
         lambda sig_affine, idx: threshold_recombine(
             ctx, fr_ctx, t, sig_affine, idx
         )
@@ -139,17 +185,17 @@ def _threshold_agg_kernel(ctx: ModCtx, fr_ctx: ModCtx, t: int):
 
 @functools.lru_cache(maxsize=None)
 def _verify_kernel(ctx: ModCtx):
-    return jax.jit(functools.partial(DP.batched_verify, ctx))
+    return _jit_kernel(functools.partial(DP.batched_verify, ctx))
 
 
 @functools.lru_cache(maxsize=None)
 def _verify_rlc_kernel(ctx: ModCtx, fr_ctx: ModCtx):
-    return jax.jit(functools.partial(DP.batched_verify_rlc, ctx, fr_ctx))
+    return _jit_kernel(functools.partial(DP.batched_verify_rlc, ctx, fr_ctx))
 
 
 @functools.lru_cache(maxsize=None)
 def _verify_grouped_rlc_kernel(ctx: ModCtx, fr_ctx: ModCtx):
-    return jax.jit(
+    return _jit_kernel(
         functools.partial(DP.batched_verify_grouped_rlc, ctx, fr_ctx)
     )
 
@@ -163,7 +209,7 @@ def _aggregate_kernel(ctx: ModCtx, k: int):
         proj = C.affine_to_point(f, sig_affine)
         return C.point_to_affine(f, C.point_sum(f, proj, axis=-1))
 
-    return jax.jit(kernel)
+    return _jit_kernel(kernel)
 
 
 @functools.lru_cache(maxsize=None)
@@ -174,7 +220,7 @@ def _g1_sum_kernel(ctx: ModCtx, k: int):
         proj = C.affine_to_point(f, pk_affine)
         return C.point_to_affine(f, C.point_sum(f, proj, axis=-1))
 
-    return jax.jit(kernel)
+    return _jit_kernel(kernel)
 
 
 @functools.lru_cache(maxsize=None)
@@ -186,7 +232,7 @@ def _subgroup_g2_kernel(ctx: ModCtx, fr_ctx: ModCtx):
         rp = C.point_scalar_mul(f, fr_ctx, proj, order)
         return C.point_is_identity(f, rp)
 
-    return jax.jit(kernel)
+    return _jit_kernel(kernel)
 
 
 @functools.lru_cache(maxsize=None)
@@ -198,7 +244,7 @@ def _subgroup_g1_kernel(ctx: ModCtx, fr_ctx: ModCtx):
         rp = C.point_scalar_mul(f, fr_ctx, proj, order)
         return C.point_is_identity(f, rp)
 
-    return jax.jit(kernel)
+    return _jit_kernel(kernel)
 
 
 @functools.lru_cache(maxsize=None)
@@ -209,7 +255,7 @@ def _g1_scalar_mul_kernel(ctx: ModCtx, fr_ctx: ModCtx):
         proj = C.affine_to_point(f, base_affine)
         return C.point_to_affine(f, C.point_scalar_mul(f, fr_ctx, proj, scalars))
 
-    return jax.jit(kernel)
+    return _jit_kernel(kernel)
 
 
 @functools.lru_cache(maxsize=None)
@@ -220,7 +266,7 @@ def _g2_scalar_mul_kernel(ctx: ModCtx, fr_ctx: ModCtx):
         proj = C.affine_to_point(f, base_affine)
         return C.point_to_affine(f, C.point_scalar_mul(f, fr_ctx, proj, scalars))
 
-    return jax.jit(kernel)
+    return _jit_kernel(kernel)
 
 
 # ---------------------------------------------------------------------------

@@ -22,12 +22,28 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.35 top-level spelling
+    _shard_map = jax.shard_map
+except AttributeError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from charon_tpu.ops import blsops
 from charon_tpu.ops import curve as C
 from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
 from charon_tpu.ops.limb import ModCtx
+
+
+def _dedupe_buckets(lanes, bucket_fn):
+    """Keep one representative lane count per padded bucket shape."""
+    seen, out = set(), []
+    for n in lanes:
+        b = bucket_fn(n)
+        if b not in seen:
+            seen.add(b)
+            out.append(n)
+    return out
 
 
 def make_mesh(devices=None, axis: str = "shards") -> Mesh:
@@ -124,7 +140,7 @@ class SlotCryptoPlane:
             total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis)
             return group_sig, ok, total
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
@@ -211,7 +227,7 @@ class SlotCryptoPlane:
             bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
             return group_sig, bad == 0
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(
@@ -231,7 +247,7 @@ class SlotCryptoPlane:
             ok = DP.batched_verify(ctx, pk, msg, sig)
             return jnp.logical_and(ok, live)
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             local,
             mesh=self.mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -253,7 +269,7 @@ class SlotCryptoPlane:
             bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
             return bad == 0
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             local,
             mesh=self.mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
@@ -277,8 +293,7 @@ class SlotCryptoPlane:
         import random as _random
 
         rng = rng or _random.SystemRandom()
-        shards = self.shard_count()
-        vp = v + ((-v) % shards)
+        vp = self.bucket_lanes(v)
         return jnp.asarray(
             np.asarray(
                 [
@@ -301,16 +316,35 @@ class SlotCryptoPlane:
     def shard_count(self) -> int:
         return self.mesh.devices.size
 
+    def bucket_lanes(self, n: int) -> int:
+        """Padded batch size for n lanes: the shared power-of-two bucket
+        ladder (ops/blsops.bucket_lanes), kept divisible by the mesh so
+        shard_map splits evenly. One ladder across BlsEngine and this
+        plane bounds jit-cache growth to O(log max_batch) shapes."""
+        return blsops.bucket_lanes(n, self.shard_count())
+
+    def jit_cache_size(self) -> int:
+        """Compiled-program count across this plane's four programs —
+        the bucket-discipline regression signal (see blsops counterpart)."""
+        return sum(
+            prog._cache_size()
+            for prog in (
+                self._step,
+                self._step_rlc,
+                self._verify,
+                self._verify_rlc,
+            )
+        )
+
     def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):
         """Python-int affine points -> device arrays laid out [V, t]/[V].
 
-        V that is not divisible by the mesh size is padded up by repeating
-        lane 0; padding lanes carry live=False and are excluded from the
-        psum total (and sliced off by step_host)."""
+        V is padded up to the power-of-two bucket ladder (bucket_lanes)
+        by repeating lane 0; padding lanes carry live=False and are
+        excluded from the psum total (and sliced off by step_host)."""
         v = len(msgs)
         t = self.t
-        shards = self.shard_count()
-        pad = (-v) % shards
+        pad = self.bucket_lanes(v) - v
         if pad:
             pubshares = list(pubshares) + [pubshares[0]] * pad
             msgs = list(msgs) + [msgs[0]] * pad
@@ -353,10 +387,10 @@ class SlotCryptoPlane:
 
     def pack_verify_inputs(self, pks, msgs, sigs):
         """Python-int affine points -> [N] device arrays + live mask,
-        N padded up to the mesh size by repeating lane 0."""
+        N padded up to the power-of-two bucket ladder by repeating
+        lane 0."""
         n = len(pks)
-        shards = self.shard_count()
-        pad = (-n) % shards
+        pad = self.bucket_lanes(n) - n
         if pad:
             pks = list(pks) + [pks[0]] * pad
             msgs = list(msgs) + [msgs[0]] * pad
@@ -373,7 +407,7 @@ class SlotCryptoPlane:
         import random as _random
 
         rng = rng or _random.SystemRandom()
-        np_ = n + ((-n) % self.shard_count())
+        np_ = self.bucket_lanes(n)
         return jnp.asarray(
             np.asarray(
                 [
@@ -388,6 +422,17 @@ class SlotCryptoPlane:
             )
         )
 
+    def verify_packed(self, arrays, rand, n: int) -> list[bool]:
+        """Device stage of verify_host on an already-packed batch — the
+        coalescer's pipelined flush packs on its decode pool and calls
+        this from the serialized device lane, so host packing of window
+        k overlaps device execution of window k-1."""
+        pk, msg, sig, live = arrays
+        if bool(self._verify_rlc(pk, msg, sig, live, rand)):
+            return [True] * n
+        ok = self._verify(pk, msg, sig, live)
+        return [bool(b) for b in np.asarray(ok)[:n]]
+
     def verify_host(self, pks, msgs, sigs, rng=None) -> list[bool]:
         """Sharded batch verify of N independent (pk, msg, sig) lanes.
         RLC fast path first (one shared final-exp per shard); only a
@@ -395,12 +440,21 @@ class SlotCryptoPlane:
         n = len(pks)
         if n == 0:
             return []
-        pk, msg, sig, live = self.pack_verify_inputs(pks, msgs, sigs)
+        arrays = self.pack_verify_inputs(pks, msgs, sigs)
         rand = self.make_lane_rand(n, rng=rng)
-        if bool(self._verify_rlc(pk, msg, sig, live, rand)):
-            return [True] * n
-        ok = self._verify(pk, msg, sig, live)
-        return [bool(b) for b in np.asarray(ok)[:n]]
+        return self.verify_packed(arrays, rand, n)
+
+    def recombine_packed(self, args, rand, v: int):
+        """Device stage of recombine_host on an already-packed [V, t]
+        batch (see verify_packed for the pipelining contract)."""
+        group_sig, all_ok = self.step_rlc(*args, rand)
+        if bool(all_ok):
+            return C.g2_unpack(self.ctx, group_sig)[:v], [True] * v
+        group_sig, ok, _total = self.step(*args)
+        return (
+            C.g2_unpack(self.ctx, group_sig)[:v],
+            [bool(b) for b in np.asarray(ok)[:v]],
+        )
 
     def recombine_host(
         self, pubshares, msgs, partials, group_pks, indices, rng=None
@@ -414,11 +468,66 @@ class SlotCryptoPlane:
             return [], []
         args = self.pack_inputs(pubshares, msgs, partials, group_pks, indices)
         rand = self.make_rand(v, rng=rng)
-        group_sig, all_ok = self.step_rlc(*args, rand)
-        if bool(all_ok):
-            return C.g2_unpack(self.ctx, group_sig)[:v], [True] * v
-        group_sig, ok, _total = self.step(*args)
-        return (
-            C.g2_unpack(self.ctx, group_sig)[:v],
-            [bool(b) for b in np.asarray(ok)[:v]],
-        )
+        return self.recombine_packed(args, rand, v)
+
+    # canonical duty shapes: lane 1 catches the SMALLEST bucket (a lone
+    # first-slot submission pads to the shard count, not to 16), the
+    # rest cover the burst sizes; duplicates after bucket-padding are
+    # compiled once (e.g. 1 and 16 share bucket 16 on a 16-shard mesh)
+    PREWARM_VERIFY_LANES = (1, 16, 64, 256)
+    PREWARM_RECOMBINE_LANES = (1, 16, 64)
+
+    def prewarm(
+        self,
+        verify_lanes=None,
+        recombine_lanes=None,
+    ) -> list[tuple[str, int, float]]:
+        """Trace + compile the canonical duty shapes up front so the
+        first live slot never eats a cold pairing compile on the duty
+        path (XLA pairing programs compile in minutes cold).
+
+        Each shape compiles BOTH tiers EXPLICITLY — the RLC fast path
+        AND the per-lane attribution program (generator-point dummies
+        are valid triples, so the RLC early-return would otherwise skip
+        the attribution tier and the first forged lane mid-slot would
+        still eat a cold compile). Shapes land on the same bucket
+        ladder live flushes pad to, deduplicated per bucket. Returns
+        [(kind, bucket_lanes, seconds)] per compiled shape."""
+        import time as _time
+
+        from charon_tpu.crypto.g1g2 import G1_GEN, G2_GEN
+
+        if verify_lanes is None:
+            verify_lanes = self.PREWARM_VERIFY_LANES
+        if recombine_lanes is None:
+            recombine_lanes = self.PREWARM_RECOMBINE_LANES
+        verify_lanes = _dedupe_buckets(verify_lanes, self.bucket_lanes)
+        recombine_lanes = _dedupe_buckets(recombine_lanes, self.bucket_lanes)
+        report: list[tuple[str, int, float]] = []
+        for n in verify_lanes:
+            t0 = _time.monotonic()
+            pk, msg, sig, live = self.pack_verify_inputs(
+                [G1_GEN] * n, [G2_GEN] * n, [G2_GEN] * n
+            )
+            rand = self.make_lane_rand(n)
+            bool(self._verify_rlc(pk, msg, sig, live, rand))
+            np.asarray(self._verify(pk, msg, sig, live))
+            report.append(("verify", self.bucket_lanes(n),
+                           _time.monotonic() - t0))
+        t = self.t
+        idx_row = list(range(1, t + 1))
+        for v in recombine_lanes:
+            t0 = _time.monotonic()
+            args = self.pack_inputs(
+                [[G1_GEN] * t] * v,
+                [G2_GEN] * v,
+                [[G2_GEN] * t] * v,
+                [G1_GEN] * v,
+                [idx_row] * v,
+            )
+            rand = self.make_rand(v)
+            self.step_rlc(*args, rand)
+            np.asarray(self.step(*args)[1])
+            report.append(("recombine", self.bucket_lanes(v),
+                           _time.monotonic() - t0))
+        return report

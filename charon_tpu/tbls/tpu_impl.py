@@ -32,9 +32,8 @@ from charon_tpu.tbls import Implementation, TblsError
 from charon_tpu.tbls.python_impl import PythonImpl, sig_to_point
 
 
-@functools.lru_cache(maxsize=65536)
-def _cached_pubkey_point(pubkey: bytes):
-    """Decompress + subgroup-check a pubkey once; amortized across slots."""
+def _decode_pubkey_point(pubkey: bytes):
+    """Decompress + subgroup-check a pubkey (uncached decode body)."""
     try:
         pt = g1g2.g1_from_bytes(pubkey, subgroup_check=True)
     except ValueError as e:
@@ -44,9 +43,25 @@ def _cached_pubkey_point(pubkey: bytes):
     return pt
 
 
-@functools.lru_cache(maxsize=16384)
-def _cached_msg_point(data: bytes):
+def _decode_msg_point(data: bytes):
     return h2c.hash_to_g2(data)
+
+
+def make_point_cache(decode, maxsize: int):
+    """LRU-wrap a point decoder. The module-level caches below use the
+    production capacities; tests build small-capacity instances of the
+    SAME wrapper to pin hit/eviction/concurrency behavior (the caches
+    are hammered from the coalescer's decode pool, so the thread-safety
+    of functools.lru_cache is load-bearing)."""
+    return functools.lru_cache(maxsize=maxsize)(decode)
+
+
+# Decompressed pubkeys cached by compressed bytes (cluster pubshares are
+# a small static set — ref: core/validatorapi pubshare maps), as are
+# hashed messages. Shared by this impl AND core/cryptoplane's decode
+# pool.
+_cached_pubkey_point = make_point_cache(_decode_pubkey_point, 65536)
+_cached_msg_point = make_point_cache(_decode_msg_point, 16384)
 
 
 class TPUImpl(Implementation):

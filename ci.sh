@@ -2,10 +2,11 @@
 # CI tiers for charon-tpu (the runnable encoding of CI.md; VERDICT r5
 # next-round #6). Usage:
 #
-#   ./ci.sh fast    # default — workflow/networking/crypto-host tier
-#   ./ci.sh slow    # compile-heavy JAX kernels + multi-process harnesses
-#   ./ci.sh full    # both tiers
-#   ./ci.sh chaos   # seeded chaos scenarios only (subset of fast)
+#   ./ci.sh fast      # default — workflow/networking/crypto-host tier
+#   ./ci.sh slow      # compile-heavy JAX kernels + multi-process harnesses
+#   ./ci.sh full      # both tiers
+#   ./ci.sh chaos     # seeded chaos scenarios only (subset of fast)
+#   ./ci.sh hostplane # event-loop-stall regression guard (subset of fast)
 #
 # Every tier pins JAX to CPU (the canonical test env; TPU runs go
 # through bench.py / the dryrun) and a fixed PYTHONHASHSEED so the
@@ -26,8 +27,18 @@ case "$TIER" in
     # Wall-clock budget: ~3 min unloaded, <15 min on a loaded 1-core VM
     # (mirrors the reference's 5-minute unit guard). Includes the chaos
     # scenario suite under its fixed seed (tests/test_chaos_scenarios.py
-    # SEED) — the -m default in pytest.ini already deselects slow.
-    exec "${PYTEST[@]}" tests/ -m 'not slow' --continue-on-collection-errors
+    # SEED) — the -m default in pytest.ini already deselects slow —
+    # plus the hostplane smoke (ISSUE 3): event-loop-stall regressions
+    # in the pipelined crypto coalescer fail the fast tier.
+    "${PYTEST[@]}" tests/ -m 'not slow' --continue-on-collection-errors
+    exec python bench_hostplane.py --smoke
+    ;;
+  hostplane)
+    # Wall-clock budget: ~30 s. Tiny shapes, CPU, no jax: asserts the
+    # coalescer's decode pool keeps event-loop stall >= 3x below the
+    # synchronous path and that double-buffered flushes overlap host
+    # decode with the in-flight device program (bench_hostplane.py).
+    exec python bench_hostplane.py --smoke
     ;;
   slow)
     # Wall-clock budget: minutes-per-file warm, up to hours cold (big
@@ -36,9 +47,11 @@ case "$TIER" in
     exec "${PYTEST[@]}" tests/ -m slow
     ;;
   full)
-    # fast + slow budgets combined; run when touching kernel families
-    # or before cutting a round record.
-    exec "${PYTEST[@]}" tests/ -m 'slow or not slow' --continue-on-collection-errors
+    # fast + slow budgets combined (incl. the hostplane smoke the fast
+    # tier gates on); run when touching kernel families or before
+    # cutting a round record.
+    "${PYTEST[@]}" tests/ -m 'slow or not slow' --continue-on-collection-errors
+    exec python bench_hostplane.py --smoke
     ;;
   chaos)
     # Wall-clock budget: ~2 min unloaded. The 8 seeded fault scenarios
@@ -48,7 +61,7 @@ case "$TIER" in
     exec "${PYTEST[@]}" tests/test_chaos_scenarios.py tests/test_retry_backoff.py
     ;;
   *)
-    echo "usage: $0 [fast|slow|full|chaos]" >&2
+    echo "usage: $0 [fast|slow|full|chaos|hostplane]" >&2
     exit 2
     ;;
 esac

@@ -146,7 +146,7 @@ def test_app_wires_crypto_plane_on_multidevice(tmp_path):
         assert node.vapi.plane is plane
         assert node.sigagg.pubshares_by_idx is not None
         assert plane.plane.shard_count() == 8
-        assert plane.metrics_hook is not None
+        assert plane.stats_hook is not None
 
         node_off = await build_node(
             Config(

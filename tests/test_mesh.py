@@ -6,8 +6,9 @@ ref: tbls/tbls_test.go:209-237).
 
 The original cases use t=3 and a padded V of 8 so one compiled kernel
 serves them all (XLA compiles per shape); the realistic-shape tests at
-the bottom INTENTIONALLY add their own shapes (264-lane verify, 72-lane
-recombine) — each is a fresh pairing-program compile in this tier."""
+the bottom INTENTIONALLY add their own bucket shapes (256-lane verify,
+32-lane recombine — blsops.bucket_lanes ladder) — each is a fresh
+pairing-program compile in this tier."""
 
 import random
 
@@ -333,9 +334,10 @@ from charon_tpu.tbls.native_impl import NativeImpl
 assert len(jax.devices()) == 8, "inherited XLA_FLAGS must provision 8 devices"
 plane = SlotCryptoPlane(make_mesh(jax.devices()), t=3)
 
-# 257 lanes: NOT divisible by the 8-device mesh (padded to 264, so the
-# mesh carries uneven live lanes); lane 123 holds a FORGED signature.
-n = 257
+# 130 lanes: NOT divisible by the 8-device mesh (padded to the 256
+# bucket — blsops.bucket_lanes ladder — so the mesh carries masked
+# padding lanes); lane 123 holds a FORGED signature.
+n = 130
 forged_idx = 123
 det = random.Random(4242)
 msg_pool_raw = [b"mesh-verify-%d" % i for i in range(8)]
@@ -347,7 +349,7 @@ sigs = [bls.sign(sks[i], msg_pool_raw[i % 8]) for i in range(n)]
 sigs[forged_idx] = bls.sign(det.randrange(1, R), msg_pool_raw[forged_idx % 8])
 
 pk, msg, sig, live = plane.pack_verify_inputs(pks, msgs, sigs)
-assert int(live.shape[0]) == 264  # 257 padded to 8*33: uneven shards
+assert int(live.shape[0]) == 256  # 130 padded to 8 * pow2(17): bucket
 rand = plane.make_lane_rand(n, rng=random.Random(7))
 
 # masked: the forged lane contributes exponent 0 -> whole batch verifies
@@ -358,7 +360,7 @@ assert bool(plane._verify_rlc(pk, msg, sig, live_masked, rand))
 
 # unmasked, via the PUBLIC entry point the coalescer calls: the RLC
 # pass refuses the batch, the per-lane fallback attributes — and the
-# result is bit-identical to the native host oracle on all 257 lanes
+# result is bit-identical to the native host oracle on all 130 lanes
 ok = plane.verify_host(pks, msgs, sigs, rng=random.Random(8))
 impl = NativeImpl()
 oracle = []
@@ -377,7 +379,7 @@ print("REALISTIC-VERIFY-OK")
 
 
 def test_sharded_verify_realistic_shape():
-    """257 uneven-sharded lanes with a masked forged lane; per-lane
+    """130 uneven-sharded lanes with a masked forged lane; per-lane
     attribution bit-identical to the native host oracle (body runs in a
     fresh subprocess — see section comment)."""
     _run_isolated(_REALISTIC_VERIFY_SCRIPT, "REALISTIC-VERIFY-OK")
@@ -396,8 +398,9 @@ assert len(jax.devices()) == 8
 T = 3
 plane = SlotCryptoPlane(make_mesh(jax.devices()), t=T)
 
-# 67 validators: padded to 72 over 8 shards, 5 masked padding lanes
-v = 67
+# 29 validators: padded to the 32 bucket over 8 shards (blsops
+# bucket ladder), 3 masked padding lanes
+v = 29
 pubshares, msgs, partials, group_pks, indices = [], [], [], [], []
 for i in range(v):
     det = random.Random(1000 + i)
@@ -415,7 +418,7 @@ sigs, oks = plane.recombine_host(
     pubshares, msgs, partials, group_pks, indices, rng=random.Random(3)
 )
 assert oks == [True] * v
-for lane in (0, 13, 41, 66):
+for lane in (0, 13, 21, 28):
     want = shamir.threshold_aggregate_g2(
         dict(zip(indices[lane], partials[lane]))
     )
@@ -425,7 +428,7 @@ print("REALISTIC-RECOMBINE-OK")
 
 
 def test_sharded_recombine_uneven_vs_oracle():
-    """67 validators recombine+verify in one sharded RLC program;
+    """29 validators recombine+verify in one sharded RLC program;
     group signatures bit-identical to the host Lagrange oracle (body
     runs in a fresh subprocess — see section comment)."""
     _run_isolated(_REALISTIC_RECOMBINE_SCRIPT, "REALISTIC-RECOMBINE-OK")
