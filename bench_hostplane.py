@@ -127,8 +127,14 @@ async def run_phase(
 
     _clear_decode_caches()
     plane = SimPlane(t=3, device_s=device_s)
+    # per-flush stage spans travel on FlushStats (the same fields the
+    # tracer bridge consumes) — collect them via the stats hook
+    stats: list = []
     coal = SlotCoalescer(
-        plane, window=window, decode_workers=decode_workers, trace=True
+        plane,
+        window=window,
+        decode_workers=decode_workers,
+        stats_hook=stats.append,
     )
     stop = asyncio.Event()
     probe = asyncio.create_task(_stall_probe(stop))
@@ -166,7 +172,9 @@ async def run_phase(
     assert all(all(r) for r in res1) and all(res2)
     coal.close()
 
-    host_spans = coal.decode_spans + coal.pack_spans
+    host_spans = [sp for s in stats for sp in s.decode_spans]
+    host_spans += [s.pack_span for s in stats if s.pack_span is not None]
+    device_spans = [s.device_span for s in stats if s.device_span is not None]
     return {
         "decode_workers": decode_workers,
         "lanes": len(items),
@@ -179,7 +187,7 @@ async def run_phase(
             sum(latencies) / len(latencies), 4
         ),
         "host_device_overlap_seconds": round(
-            overlap_seconds(host_spans, coal.device_spans), 4
+            overlap_seconds(host_spans, device_spans), 4
         ),
         "overlapped_flushes": coal.overlapped_flushes,
         "max_inflight": coal.max_inflight,

@@ -49,6 +49,15 @@ def reset_ctx(token: contextvars.Token) -> None:
 
 def _fmt(msg: str, topic: str, fields: dict) -> str:
     all_fields = {**_ctx_fields.get(), **fields}
+    # logs and traces cross-reference: a record emitted inside an active
+    # span carries its trace id (ref: the reference stamps trace IDs
+    # into zap fields via the log/trace bridge). Explicit fields win.
+    if "trace_id" not in all_fields:
+        from charon_tpu.app.tracer import current_ctx
+
+        ctx = current_ctx()
+        if ctx is not None:
+            all_fields["trace_id"] = ctx[0]
     parts = [f"[{topic}]", msg]
     parts.extend(f"{k}={v}" for k, v in all_fields.items())
     return " ".join(parts)

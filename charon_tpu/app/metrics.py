@@ -191,6 +191,30 @@ class ClusterMetrics:
             "Flushes whose host stages overlapped a device program "
             "still in flight (double-buffered windows)",
         )
+        # duty-rooted tracing (ISSUE 4): per-step latency from span
+        # ends plus the slow-duty detector's wall-time/budget verdicts
+        self.step_latency = Histogram(
+            "core_step_latency_seconds",
+            "Workflow step latency derived from span ends (wire edges, "
+            "parsigex/qbft receive paths, crypto-plane stages)",
+            labels + ["step"],
+            registry=self.registry,
+            buckets=(0.001, 0.005, 0.02, 0.05, 0.2, 0.5, 2.0, 10.0),
+        )
+        self.duty_wall_seconds = Histogram(
+            "core_duty_wall_seconds",
+            "Duty wall time: first span start to last span end of the "
+            "duty trace, observed at duty expiry",
+            labels + ["duty"],
+            registry=self.registry,
+            buckets=(0.05, 0.2, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 60.0),
+        )
+        self.duty_slow = counter(
+            "core_duty_slow_total",
+            "Duties whose traced wall time exceeded the deadline budget "
+            "(slow-duty detector over span ends)",
+            ["duty"],
+        )
 
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)
@@ -230,6 +254,106 @@ def instrument(metrics: "ClusterMetrics"):
     return option
 
 
+def span_metrics(metrics: "ClusterMetrics"):
+    """Tracer hook (app/tracer.Tracer.hooks): observe every finished
+    span's duration into the per-step latency histogram. Runs on
+    whatever thread records the span — prometheus client objects are
+    thread-safe."""
+
+    def hook(span) -> None:
+        # bridged crypto-plane stages are recorded once per duty trace
+        # that rode the flush; copies carry shared=True so one physical
+        # flush observes each stage latency exactly once
+        if span.attrs.get("shared"):
+            return
+        metrics.labels(metrics.step_latency, span.name).observe(
+            max(0.0, span.end - span.start)
+        )
+
+    return hook
+
+
+class SlowDutyDetector:
+    """Duty wall-time vs deadline budget, derived from span ends
+    (ISSUE 4: 'was the duty late?' answered from the trace, not logs).
+
+    Feed every finished span via `observe` (a tracer hook); at duty
+    expiry call `finalize(duty, budget)` — it computes the traced wall
+    time (first span start to last span end across the duty's
+    deterministic trace) and flags the duty slow when it exceeded the
+    budget. State is per-trace and popped at finalize, so memory is
+    bounded by in-flight duties."""
+
+    def __init__(self, metrics: "ClusterMetrics | None" = None) -> None:
+        import threading
+
+        self.metrics = metrics
+        self._window: dict[str, tuple[float, float]] = {}
+        # observe() runs as a tracer hook on whatever thread records the
+        # span — device worker threads for bridged plane spans, the
+        # event loop for wire edges. Serialize the read-modify-write
+        # (and the eviction scan) or concurrent observes lose window
+        # updates / crash mid-iteration.
+        self._lock = threading.Lock()
+        self.slow_total = 0
+        self.last: dict | None = None  # most recent finalize verdict
+
+    def observe(self, span) -> None:
+        with self._lock:
+            cur = self._window.get(span.trace_id)
+            if cur is None:
+                self._window[span.trace_id] = (span.start, span.end)
+            else:
+                self._window[span.trace_id] = (
+                    min(cur[0], span.start),
+                    max(cur[1], span.end),
+                )
+            # bounded: a trace that never finalizes (non-duty spans)
+            # must not leak; duty traces are finalized long before 4096
+            # others
+            if len(self._window) > 4096:
+                for k in list(self._window)[:2048]:
+                    self._window.pop(k, None)
+
+    def finalize(self, duty, budget: float) -> float | None:
+        """Wall seconds of the duty's trace, or None when no spans were
+        recorded. `budget` is the duty's allotted seconds (deadline
+        minus slot start)."""
+        from charon_tpu.app.tracer import duty_trace_id
+
+        with self._lock:
+            window = self._window.pop(duty_trace_id(duty), None)
+        if window is None:
+            return None
+        wall = max(0.0, window[1] - window[0])
+        slow = budget > 0 and wall > budget
+        self.last = {
+            "duty": str(duty),
+            "wall_seconds": wall,
+            "budget_seconds": budget,
+            "slow": slow,
+        }
+        d = str(duty.type.name).lower()
+        if self.metrics is not None:
+            self.metrics.labels(self.metrics.duty_wall_seconds, d).observe(
+                wall
+            )
+        if slow:
+            self.slow_total += 1
+            if self.metrics is not None:
+                self.metrics.labels(self.metrics.duty_slow, d).inc()
+            from charon_tpu.app import log
+
+            log.warn(
+                "slow duty: traced wall time exceeded deadline budget",
+                topic="tracer",
+                duty=str(duty),
+                wall_seconds=round(wall, 3),
+                budget_seconds=round(budget, 3),
+            )
+        return wall
+
+
 # cProfile is interpreter-global state: exactly one /debug/pprof/profile
 # may hold it at a time (a concurrent enable() raises on CPython 3.12)
 _PROFILE_ACTIVE = asyncio.Lock()
@@ -242,10 +366,12 @@ async def serve_monitoring(
     health_checker=None,
     ready_fn=None,
     consensus_dump=None,
+    tracer=None,
 ) -> asyncio.AbstractServer:
     """Minimal HTTP endpoint: /metrics, /livez, /readyz, /debug/traces,
-    /debug/consensus (ref: app/monitoringapi.go:47; docs/consensus.md:74
-    for the consensus debugger)."""
+    /debug/duty/<slot>, /debug/consensus (ref: app/monitoringapi.go:47;
+    docs/consensus.md:74 for the consensus debugger). `tracer` overrides
+    the process-global span store for the debug trace endpoints."""
 
     async def handle(reader, writer):
         try:
@@ -267,10 +393,42 @@ async def serve_monitoring(
                 query = parse_qs(urlsplit(path).query)
                 trace_id = (query.get("trace_id") or [None])[0]
                 body = _json.dumps(
-                    _tracer.global_tracer().dump(trace_id)
+                    (tracer or _tracer.global_tracer()).dump(trace_id)
                 ).encode()
                 ctype = b"application/json"
                 status = b"200 OK"
+            elif path.startswith("/debug/duty/"):
+                # assembled per-duty timeline for one slot: every trace
+                # with spans at that slot, depth-annotated (JSON), or a
+                # plain-text waterfall with ?format=text (ISSUE 4)
+                from charon_tpu.app import tracer as _tracer
+
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(path)
+                raw_slot = parts.path.split("/debug/duty/", 1)[1].strip("/")
+                fmt = (parse_qs(parts.query).get("format") or ["json"])[0]
+                try:
+                    slot = int(raw_slot)
+                except ValueError:
+                    slot = None
+                timelines = (
+                    _tracer.duty_timeline(slot, tracer=tracer)
+                    if slot is not None
+                    else []
+                )
+                if not timelines:
+                    body = b"no spans recorded for that slot"
+                    ctype = b"text/plain"
+                    status = b"404 Not Found"
+                elif fmt == "text":
+                    body = _tracer.render_waterfall(timelines).encode()
+                    ctype = b"text/plain"
+                    status = b"200 OK"
+                else:
+                    body = _json.dumps(timelines).encode()
+                    ctype = b"application/json"
+                    status = b"200 OK"
             elif path.startswith("/debug/pprof/profile"):
                 # CPU profile of the event-loop thread for ?seconds=N
                 # (ref: monitoringapi.go net/http/pprof profile endpoint)

@@ -41,7 +41,7 @@ from charon_tpu.core.tracker import Tracker, tracking
 from charon_tpu.core.types import DutyType, PubKey, pubkey_from_bytes
 from charon_tpu.core.validatorapi import ValidatorAPI
 from charon_tpu.core.vapi_http import VapiRouter
-from charon_tpu.core.wire import wire
+from charon_tpu.core.wire import tracing, wire
 from charon_tpu.eth2util import enr, keystore
 from charon_tpu.eth2util.signing import ForkInfo
 from charon_tpu.p2p.adapters import TcpParSigTransport, TcpQbftNet
@@ -87,6 +87,10 @@ class Config:
     # OTLP/HTTP collector for workflow spans (ref: --jaeger-address,
     # app/app.go:1014-1027 wireTracing); "" disables export
     tracing_endpoint: str = ""
+    # per-node span JSONL export path; per-node files from a cluster
+    # merge offline into one cross-node timeline (tracer.merge_jsonl —
+    # the deterministic duty trace ids make the merge trivial)
+    tracing_jsonl: str = ""
     # seeded fault-injection spec ("seed=42,drop=0.1,bn_error=0.2"; see
     # app/faultinject + testutil/chaos). "" keeps the plane inert: no
     # wrapper objects are constructed on the un-instrumented path.
@@ -236,10 +240,38 @@ async def build_node(config: Config) -> Node:
         cluster_name=lock.definition.name,
         peer=f"node{config.node_index}",
     )
+
+    # -- tracing ----------------------------------------------------------
+    # installed BEFORE the workflow wires so every span — including those
+    # recorded during component construction — lands in this node's
+    # tracer (ref: app/app.go:162 wireTracing runs first)
+    otlp = None
+    if config.tracing_endpoint:
+        otlp = tracer.OTLPExporter(
+            config.tracing_endpoint,
+            service_name=f"charon-tpu-node{config.node_index}",
+        )
+    if otlp is not None or config.tracing_jsonl:
+        tracer.set_global_tracer(
+            tracer.Tracer(
+                jsonl_path=config.tracing_jsonl or None, exporter=otlp
+            )
+        )
+    node_tracer = tracer.global_tracer()
+    # span ends feed the per-step latency histograms and the slow-duty
+    # detector (finalized at duty expiry, below)
+    from charon_tpu.app.metrics import SlowDutyDetector, span_metrics
+
+    slow_detector = SlowDutyDetector(metrics)
+    # keep handles so shutdown can unhook: node_tracer may be the
+    # process-global tracer (default build), and a later build_node in
+    # the same process must not feed spans into THIS node's registry
+    _node_hooks = [span_metrics(metrics), slow_detector.observe]
+    node_tracer.hooks.extend(_node_hooks)
     if crypto_plane is not None:
         # one rich per-flush stats hook (runs on the device worker
         # thread — prometheus client objects are thread-safe)
-        def _plane_stats(s) -> None:
+        def _plane_stats(s) -> None:  # chained behind the span bridge
             metrics.labels(metrics.plane_flushes).inc()
             if s.jobs >= 2:
                 metrics.labels(metrics.plane_coalesced).inc()
@@ -258,7 +290,12 @@ async def build_node(config: Config) -> Node:
             if s.inflight >= 2:
                 metrics.labels(metrics.plane_overlapped).inc()
 
-        crypto_plane.stats_hook = _plane_stats
+        # bridge each flush's decode/pack/device stages into tracer
+        # spans joined to the duty traces that rode the flush (ISSUE 4
+        # replaces cryptoplane's old trace=True tuples with this)
+        crypto_plane.stats_hook = tracer.plane_span_bridge(
+            node_tracer, inner_hook=_plane_stats
+        )
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -470,7 +507,7 @@ async def build_node(config: Config) -> Node:
         sigagg=sigagg,
         aggsigdb=aggsigdb,
         broadcaster=bcast,
-        options=[tracking(tracker), tracer.tracing(), instrument(metrics)],
+        options=[tracking(tracker), tracing(node_tracer), instrument(metrics)],
     )
 
     # tracker reports -> metrics: failures, participation counts,
@@ -511,10 +548,20 @@ async def build_node(config: Config) -> Node:
 
     tracker.subscribe(_report_metrics)
 
-    # deadliner trims stores + triggers tracker analysis
+    # deadliner trims stores + triggers tracker analysis; the slow-duty
+    # detector settles each duty's traced wall time against its budget
+    # (deadline minus slot start) at the same expiry point
     deadliner = Deadliner(
         clock,
-        _make_expiry(dutydb, parsigdb, aggsigdb, tracker, qbft_consensus),
+        _make_expiry(
+            dutydb,
+            parsigdb,
+            aggsigdb,
+            tracker,
+            qbft_consensus,
+            slow_detector=slow_detector,
+            clock=clock,
+        ),
     )
     scheduler.subscribe_duties(_register_deadline(deadliner))
     # recaster: re-broadcast VC + lock-file registrations once per epoch
@@ -777,27 +824,32 @@ async def build_node(config: Config) -> Node:
 
     life.register_start(Order.MONITORING, "health-sampler", _sample_health_loop)
 
-    if config.tracing_endpoint:
-        # ref: app/app.go:162 wireTracing — spans flow to the collector
-        # for the node's whole life; flushed at shutdown.
-        otlp = tracer.OTLPExporter(
-            config.tracing_endpoint,
-            service_name=f"charon-tpu-node{config.node_index}",
-        )
-        tracer.set_global_tracer(tracer.Tracer(exporter=otlp))
+    # exporter/JSONL built at the top of build_node (spans flow for the
+    # node's whole life); flushed + closed at shutdown. Registered
+    # unconditionally: the metric/slow-duty hooks must come OFF the
+    # tracer even in default builds where it is the process-global one,
+    # or a rebuild in the same process would keep feeding spans into
+    # this node's dead registry.
+    _own_tracer = otlp is not None or bool(config.tracing_jsonl)
 
-        async def stop_tracing():
-            # shutdown joins the export thread (final POST can take
+    async def stop_tracing():
+        for h in _node_hooks:
+            try:
+                node_tracer.hooks.remove(h)
+            except ValueError:
+                pass
+        if _own_tracer:
+            # close() joins the export thread (final POST can take
             # seconds against a dead collector) — keep the loop free so
             # later stop hooks' grace timeouts still fire
             await asyncio.get_running_loop().run_in_executor(
-                None, otlp.shutdown
+                None, node_tracer.close
             )
 
-        # TRACKER order (lowest): stop hooks run highest-first, so the
-        # exporter flushes AFTER p2p/beacon teardown — spans recorded
-        # during other components' shutdown still reach the collector
-        life.register_stop(Order.TRACKER, "tracing", stop_tracing)
+    # TRACKER order (lowest): stop hooks run highest-first, so the
+    # exporter flushes AFTER p2p/beacon teardown — spans recorded
+    # during other components' shutdown still reach the collector
+    life.register_stop(Order.TRACKER, "tracing", stop_tracing)
 
     if config.monitoring_port:
         consensus_dump = getattr(qbft_consensus, "debug_dump", None)
@@ -809,6 +861,7 @@ async def build_node(config: Config) -> Node:
                 metrics,
                 health_checker=health,
                 consensus_dump=consensus_dump,
+                tracer=node_tracer,
             )
 
         life.register_start(Order.MONITORING, "monitoring", start_mon, background=False)
@@ -847,13 +900,24 @@ def _log_inclusion(report: InclusionReport) -> None:
         )
 
 
-def _make_expiry(dutydb, parsigdb, aggsigdb, tracker, consensus=None):
+def _make_expiry(
+    dutydb,
+    parsigdb,
+    aggsigdb,
+    tracker,
+    consensus=None,
+    slow_detector=None,
+    clock=None,
+):
     async def on_expired(duty):
         dutydb.trim(duty)
         parsigdb.trim(duty)
         aggsigdb.trim(duty)
         if consensus is not None:
             consensus.trim(duty)
+        if slow_detector is not None and clock is not None:
+            budget = clock.duty_deadline(duty) - clock.slot_start(duty.slot)
+            slow_detector.finalize(duty, budget)
         await tracker.duty_expired(duty)
 
     return on_expired

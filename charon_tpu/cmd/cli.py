@@ -171,6 +171,13 @@ def build_parser() -> argparse.ArgumentParser:
         "(e.g. http://jaeger:4318; ref charon --jaeger-address)",
     )
     runp.add_argument(
+        "--tracing-jsonl",
+        default=_env_default("tracing-jsonl", ""),
+        help="per-node span JSONL export path; per-node files merge "
+        "offline into one cross-node duty timeline (duty trace ids "
+        "are deterministic across the cluster)",
+    )
+    runp.add_argument(
         "--beacon-urls",
         default=_env_default("beacon-urls", ""),
         help="comma-separated beacon-node HTTP endpoints (failover order)",
@@ -505,6 +512,7 @@ def cmd_run(args) -> int:
         crypto_plane_decode_workers=args.crypto_plane_decode_workers,
         crypto_plane_prewarm=args.crypto_plane_prewarm,
         tracing_endpoint=args.tracing_endpoint,
+        tracing_jsonl=args.tracing_jsonl,
         relay_addr=args.relay,
         fault_injection=args.fault_injection,
     )

@@ -42,10 +42,21 @@ class MemMsgNet:
         self.nodes.append(node)
         return len(self.nodes) - 1
 
-    async def broadcast(self, from_idx: int, duty: Duty, msg: qbft.Msg, values) -> None:
+    async def broadcast(
+        self,
+        from_idx: int,
+        duty: Duty,
+        msg: qbft.Msg,
+        values,
+        tctx: str | None = None,
+    ) -> None:
+        # simulated network boundary: see parsigex.MemTransport.send
+        from charon_tpu.app.tracer import detached
+
         for node in self.nodes:
             if node.node_idx != from_idx:
-                node.deliver(duty, msg, values)
+                with detached():
+                    node.deliver(duty, msg, values, tctx=tctx)
 
 
 class QBFTConsensus:
@@ -62,6 +73,7 @@ class QBFTConsensus:
         gater=None,
         timer: str | None = None,
         linear_round_inc: float = qbft.LINEAR_ROUND_INC,
+        tracer=None,  # app/tracer.Tracer; None = process-global
     ) -> None:
         """`privkey`/`pubkeys` enable per-message k1 authentication
         (ref: core/consensus/qbft/transport.go:25-50 signs every msg,
@@ -80,6 +92,7 @@ class QBFTConsensus:
         cluster default)."""
         self.net = net
         self.node_idx = net.attach(self)
+        self.tracer = tracer
         self._privkey = privkey
         self._pubkeys = pubkeys
         # Duty gater: without it, deliver() would create transports and
@@ -190,44 +203,70 @@ class QBFTConsensus:
 
             async def bcast(msg: qbft.Msg) -> None:
                 self._sniff("out", duty, msg)
+                # frame carries the sender's trace context so follower
+                # nodes' message-handling spans join this duty trace
+                from charon_tpu.app.tracer import encode_ctx
+
                 await self.net.broadcast(
                     self.node_idx,
                     duty,
                     msg,
                     dict(self._values.get(duty, {})),
+                    tctx=encode_ctx(),
                 )
 
             tr = qbft.Transport(bcast)
             self._instances[duty] = tr
         return tr
 
-    def deliver(self, duty: Duty, msg: qbft.Msg, values) -> None:
+    def deliver(
+        self, duty: Duty, msg: qbft.Msg, values, tctx: str | None = None
+    ) -> None:
         """Incoming message from the fabric; values-by-hash cache merge.
 
         Each received value is re-hashed and inserted only under its
         *recomputed* key, and existing entries are never overwritten — a
         peer cannot bind a decided hash to substituted duty data
-        (ref: core/consensus/qbft/qbft.go valuesByHash recomputes)."""
+        (ref: core/consensus/qbft/qbft.go valuesByHash recomputes).
+
+        `tctx` is the sending node's propagated trace context: the
+        message-handling span joins the sender's duty trace, which is
+        how a follower's consensus work appears in the proposer's
+        cross-node timeline. Malformed tctx decodes to None (fresh
+        duty-rooted span) — frame corruption never crashes delivery."""
         if self._gater is not None and not self._gater(duty):
             return
-        self._sniff("in", duty, msg)
-        # Inbox first: if the sender is over its per-source buffer bound,
-        # its value payloads are dropped too — otherwise the cache merge
-        # would be an unbounded-memory side channel around the bound.
-        if not self._transport(duty).receive(msg):
-            return
-        cache = self._values.setdefault(duty, {})
-        # One honest node contributes one candidate value per instance, so
-        # an honest cache never exceeds n entries; cap at 2n.
-        max_values = 2 * self.defn.nodes
-        for v in values.values():
-            if len(cache) >= max_values:
-                break
-            try:
-                rh = value_hash(v)
-            except Exception:
-                continue
-            cache.setdefault(rh, v)
+        from charon_tpu.app.tracer import parse_ctx, span
+
+        with span(
+            "qbft.deliver",
+            duty=duty,
+            tracer=self.tracer,
+            remote=parse_ctx(tctx),
+            msg_type=getattr(msg.type, "name", str(msg.type)),
+            round=msg.round,
+            source=msg.source,
+        ):
+            self._sniff("in", duty, msg)
+            # Inbox first: if the sender is over its per-source buffer
+            # bound, its value payloads are dropped too — otherwise the
+            # cache merge would be an unbounded-memory side channel
+            # around the bound.
+            if not self._transport(duty).receive(msg):
+                return
+            cache = self._values.setdefault(duty, {})
+            # One honest node contributes one candidate value per
+            # instance, so an honest cache never exceeds n entries; cap
+            # at 2n.
+            max_values = 2 * self.defn.nodes
+            for v in values.values():
+                if len(cache) >= max_values:
+                    break
+                try:
+                    rh = value_hash(v)
+                except Exception:
+                    continue
+                cache.setdefault(rh, v)
 
     def _sniff(self, direction: str, duty: Duty, msg: qbft.Msg) -> None:
         import time as _time

@@ -75,6 +75,8 @@ class _VerifyJob:
     lanes: list  # [(pk_pt, msg_pt, sig_pt) | None] — None = host decode fail
     fut: asyncio.Future
     decode_delays: tuple = ()  # decode-pool queue delay per chunk
+    decode_spans: tuple = ()  # wall-clock (start, end) per decode chunk
+    parent: tuple | None = None  # submitter's (trace_id, span_id)
 
 
 @dataclass
@@ -88,12 +90,20 @@ class _RecombineJob:
     prefail: list  # [V] bool — True: fail without consulting the device
     fut: asyncio.Future
     decode_delays: tuple = ()
+    decode_spans: tuple = ()
+    parent: tuple | None = None
 
 
 @dataclass(frozen=True)
 class FlushStats:
     """Per-flush pipeline observability, delivered to `stats_hook` from
-    the device worker thread (thread-safe sinks only)."""
+    the device worker thread (thread-safe sinks only).
+
+    The stage spans (wall-clock `time.time()` windows) plus the
+    submitters' trace contexts in `parents` are everything
+    app/tracer.plane_span_bridge needs to bridge the flush into real
+    duty-rooted tracer spans; bench_hostplane.py computes its
+    host/device overlap from the same fields."""
 
     jobs: int
     lanes: int
@@ -106,6 +116,12 @@ class FlushStats:
     padded_lanes: int | None  # total lanes after bucket padding
     decode_queue_seconds: tuple[float, ...]  # decode-pool queue delays
     fallback: bool = False  # served by the python-spec rung
+    # wall-clock stage windows of THIS flush's pipeline pass
+    decode_spans: tuple[tuple[float, float], ...] = ()  # per decode chunk
+    pack_span: tuple[float, float] | None = None
+    device_span: tuple[float, float] | None = None
+    # (trace_id, span_id) captured from each submission's active span
+    parents: tuple[tuple[str, str], ...] = ()
 
 
 def _decode_pubkey(pk: bytes):
@@ -180,7 +196,6 @@ class SlotCoalescer:
         window_max: float = 0.08,
         decode_workers: int = 4,
         stats_hook=None,
-        trace: bool = False,
     ):
         import concurrent.futures
 
@@ -229,14 +244,11 @@ class SlotCoalescer:
         # counters only (runs on the device worker thread)
         self.metrics_hook = metrics_hook
         # richer per-flush pipeline stats (FlushStats) — same threading
-        # contract as metrics_hook
+        # contract as metrics_hook. Stage timing travels IN the stats
+        # (decode_spans/pack_span/device_span wall-clock windows), so
+        # the tracer bridge and bench_hostplane.py both read per-flush
+        # spans from here instead of a coalescer-global trace list.
         self.stats_hook = stats_hook
-        # trace=True records (start, end) monotonic spans per pipeline
-        # stage for bench_hostplane.py's overlap measurement
-        self.trace = trace
-        self.decode_spans: list[tuple[float, float]] = []
-        self.pack_spans: list[tuple[float, float]] = []
-        self.device_spans: list[tuple[float, float]] = []
 
     @property
     def t(self) -> int:
@@ -272,30 +284,27 @@ class SlotCoalescer:
         """Apply `fn` per item with the bigint work OFF the event loop:
         items ship to the decode pool in DECODE_CHUNK chunks (batched
         submission — one executor hop per chunk, not per lane). Returns
-        (results, per-chunk queue delays) — the delays travel with the
-        job so each flush's stats report ITS OWN decode queueing, not
-        whatever the concurrent next window happens to be decoding.
-        With the pool disabled the map runs inline on the caller — the
-        pre-pipeline synchronous path bench_hostplane.py baselines."""
+        (results, per-chunk queue delays, per-chunk wall-clock spans) —
+        both travel with the job so each flush's stats report ITS OWN
+        decode queueing/timing, not whatever the concurrent next window
+        happens to be decoding. With the pool disabled the map runs
+        inline on the caller — the pre-pipeline synchronous path
+        bench_hostplane.py baselines."""
         # closed: inline decode instead of resurrecting a pool nobody
         # will shut down (the flush fails these waiters fast anyway)
         if self.decode_workers <= 0 or self._closed:
-            if self.trace:
-                t0 = time.monotonic()
-                out = [fn(it) for it in items]
-                self.decode_spans.append((t0, time.monotonic()))
-                return out, ()
-            return [fn(it) for it in items], ()
+            w0 = time.time()
+            out = [fn(it) for it in items]
+            return out, (), ((w0, time.time()),)
         loop = asyncio.get_running_loop()
         pool = self._pool()
         submitted = time.monotonic()
 
         def run_chunk(chunk):
             t0 = time.monotonic()
+            w0 = time.time()
             out = [fn(it) for it in chunk]
-            if self.trace:
-                self.decode_spans.append((t0, time.monotonic()))
-            return out, t0 - submitted
+            return out, t0 - submitted, (w0, time.time())
 
         chunks = [
             items[i : i + self.DECODE_CHUNK]
@@ -305,11 +314,21 @@ class SlotCoalescer:
             *(loop.run_in_executor(pool, run_chunk, c) for c in chunks)
         )
         return (
-            [lane for part, _ in parts for lane in part],
-            tuple(delay for _, delay in parts),
+            [lane for part, _, _ in parts for lane in part],
+            tuple(delay for _, delay, _ in parts),
+            tuple(span for _, _, span in parts),
         )
 
     # -- submission APIs (event-loop side) --------------------------------
+
+    @staticmethod
+    def _submit_ctx():
+        """(trace_id, span_id) of the submitting context's active span —
+        how a flush's stage spans find their way into the duty traces
+        whose work they merged (app/tracer.plane_span_bridge)."""
+        from charon_tpu.app.tracer import current_ctx  # lazy: core !-> app
+
+        return current_ctx()
 
     async def verify(
         self,
@@ -330,13 +349,15 @@ class SlotCoalescer:
         ticket = loop.create_future()
         self._decode_tickets.add(ticket)
         try:
-            lanes, delays = await self._map_offloop(
+            lanes, delays, spans = await self._map_offloop(
                 _decode_verify_lane, list(items)
             )
             job = _VerifyJob(
                 lanes=lanes,
                 fut=loop.create_future(),
                 decode_delays=delays,
+                decode_spans=spans,
+                parent=self._submit_ctx(),
             )
             self._verify_q.append(job)
             self._arm(deadline)
@@ -388,7 +409,7 @@ class SlotCoalescer:
         ticket = loop.create_future()  # see verify() for the contract
         self._decode_tickets.add(ticket)
         try:
-            rows, delays = await self._map_offloop(
+            rows, delays, spans = await self._map_offloop(
                 decode_row,
                 list(zip(pubshares, roots, partials, group_pks, indices)),
             )
@@ -404,6 +425,8 @@ class SlotCoalescer:
                 prefail=prefail,
                 fut=loop.create_future(),
                 decode_delays=delays,
+                decode_spans=spans,
+                parent=self._submit_ctx(),
             )
             self._recombine_q.append(job)
             self._arm(deadline)
@@ -621,10 +644,10 @@ class SlotCoalescer:
 
     def _pack_flush(self, vq, rq):
         """Decode-pool thread: array packing + RLC randomness for the
-        whole flush. Returns (vpack, rpack) for _run_device's packed
-        fast path — this is the half of the old verify_host/
+        whole flush. Returns (vpack, rpack, pack_span) for _run_device's
+        packed fast path — this is the half of the old verify_host/
         recombine_host work that does NOT need the device lane."""
-        t0 = time.monotonic()
+        w0 = time.time()
         plane = self.plane
         vpack = None
         flat = self._flat_verify_lanes(vq)
@@ -643,9 +666,7 @@ class SlotCoalescer:
                 plane.make_rand(len(msg)),
                 len(msg),
             )
-        if self.trace:
-            self.pack_spans.append((t0, time.monotonic()))
-        return vpack, rpack
+        return vpack, rpack, (w0, time.time())
 
     # -- device side (worker thread) --------------------------------------
 
@@ -660,7 +681,10 @@ class SlotCoalescer:
         # counters update only AFTER both stages succeed: a failed flush
         # that the degrade rung retries must not double-count its lanes
         t0 = time.monotonic()
-        vpack, rpack = packed if packed is not None else (None, None)
+        w0 = time.time()
+        vpack, rpack, pack_span = (
+            packed if packed is not None else (None, None, None)
+        )
         lanes = 0
         pad_lanes = padded_lanes = 0 if packed is not None else None
         vres: list[list[bool]] = []
@@ -722,8 +746,6 @@ class SlotCoalescer:
                         live_rows += 1
                 rres.append((sigs_pts, oks))
             lanes += live_rows
-        if self.trace:
-            self.device_spans.append((t0, time.monotonic()))
         self._account_flush(
             vq,
             rq,
@@ -737,6 +759,10 @@ class SlotCoalescer:
                 pad_lanes=pad_lanes,
                 padded_lanes=padded_lanes,
                 decode_queue_seconds=self._job_decode_delays(vq, rq),
+                decode_spans=self._job_decode_spans(vq, rq),
+                pack_span=pack_span,
+                device_span=(w0, time.time()),
+                parents=self._job_parents(vq, rq),
             ),
         )
         return vres, rres
@@ -753,6 +779,21 @@ class SlotCoalescer:
         """Decode-pool queue delays of exactly THIS flush's jobs."""
         return tuple(
             delay for job in [*vq, *rq] for delay in job.decode_delays
+        )
+
+    @staticmethod
+    def _job_decode_spans(vq, rq) -> tuple:
+        """Wall-clock decode windows of exactly THIS flush's jobs."""
+        return tuple(
+            span for job in [*vq, *rq] for span in job.decode_spans
+        )
+
+    @staticmethod
+    def _job_parents(vq, rq) -> tuple:
+        """Submitting-span contexts of this flush's jobs (deduped by
+        the bridge, ordered by submission)."""
+        return tuple(
+            job.parent for job in [*vq, *rq] if job.parent is not None
         )
 
     def _account_flush(self, vq, rq, lanes: int, stats: FlushStats) -> None:
@@ -878,6 +919,7 @@ class SlotCoalescer:
         from charon_tpu.crypto import shamir
 
         t0 = time.monotonic()
+        w0 = time.time()
         lanes = 0
         vres: list[list[bool]] = []
         for job in vq:
@@ -922,6 +964,9 @@ class SlotCoalescer:
                 padded_lanes=None,
                 decode_queue_seconds=self._job_decode_delays(vq, rq),
                 fallback=True,
+                decode_spans=self._job_decode_spans(vq, rq),
+                device_span=(w0, time.time()),
+                parents=self._job_parents(vq, rq),
             ),
         )
         return vres, rres

@@ -140,12 +140,20 @@ class MemTransport:
     def attach(self, node: "ParSigEx") -> None:
         self.nodes.append(node)
 
-    async def send(self, from_idx: int, duty: Duty, signed_set) -> None:
+    async def send(
+        self, from_idx: int, duty: Duty, signed_set, tctx: str | None = None
+    ) -> None:
+        # loopback crosses a simulated network boundary: drop the
+        # sender's ambient span context so trace propagation happens
+        # ONLY through the frame's tctx, as it would over real sockets
+        from charon_tpu.app.tracer import detached
+
         for node in self.nodes:
             if node.share_idx == from_idx:
                 continue
             try:
-                await node.receive(duty, signed_set)
+                with detached():
+                    await node.receive(duty, signed_set, tctx=tctx)
             except Exception as e:  # noqa: BLE001 — per-peer isolation
                 from charon_tpu.app import log
 
@@ -171,12 +179,14 @@ class ParSigEx:
         verifier: Eth2Verifier | None = None,
         gater: Callable[[Duty], bool] | None = None,
         clock: SlotClock | None = None,
+        tracer=None,  # app/tracer.Tracer; None = process-global
     ) -> None:
         self.share_idx = share_idx
         self.transport = transport
         self.verifier = verifier
         self.gater = gater
         self.clock = clock
+        self.tracer = tracer
         self.dropped_stale = 0  # metric: sets gated before crypto
         self.resend_total = 0  # metric: deadline-retry resends
         self._subs: list[ExSub] = []
@@ -193,9 +203,16 @@ class ParSigEx:
         moves to a background deadline-bounded retry (fire-and-forget,
         like the reference's SendAsync) so the VC's submission path is
         never held hostage by a flapping peer link. Receivers dedup by
-        share index, so a resend that partially succeeded is safe."""
+        share index, so a resend that partially succeeded is safe.
+
+        The frame carries the sender's trace context (ref: the reference
+        propagates OTel context in its p2p envelopes), so the receiving
+        node's spans join this duty trace under true parentage."""
+        tctx = self._trace_ctx()
         try:
-            await self.transport.send(self.share_idx, duty, signed_set)
+            await self.transport.send(
+                self.share_idx, duty, signed_set, tctx=tctx
+            )
         except _transient() as e:
             if self.clock is None:
                 raise
@@ -209,11 +226,21 @@ class ParSigEx:
                 duty=str(duty),
                 err=f"{type(e).__name__}: {e}",
             )
-            task = asyncio.create_task(self._resend(duty, signed_set))
+            task = asyncio.create_task(
+                self._resend(duty, signed_set, tctx)
+            )
             self._retry_tasks.add(task)
             task.add_done_callback(self._retry_tasks.discard)
 
-    async def _resend(self, duty: Duty, signed_set) -> None:
+    @staticmethod
+    def _trace_ctx() -> str | None:
+        from charon_tpu.app.tracer import encode_ctx
+
+        return encode_ctx()
+
+    async def _resend(
+        self, duty: Duty, signed_set, tctx: str | None = None
+    ) -> None:
         import asyncio
 
         from charon_tpu.app.expbackoff import FAST_CONFIG, backoff_delay
@@ -227,27 +254,50 @@ class ParSigEx:
             await asyncio.sleep(delay)
             attempt += 1
             try:
-                await self.transport.send(self.share_idx, duty, signed_set)
+                await self.transport.send(
+                    self.share_idx, duty, signed_set, tctx=tctx
+                )
                 self.resend_total += 1
                 return
             except _transient():
                 continue
 
-    async def receive(self, duty: Duty, signed_set: dict[PubKey, ParSignedData]) -> None:
+    async def receive(
+        self,
+        duty: Duty,
+        signed_set: dict[PubKey, ParSignedData],
+        tctx: str | None = None,
+    ) -> None:
         """Peer partials arrive; gate, verify, then store
         (ref: parsigex.go:68-109). The gater runs *before* signature
-        verification so stale floods never reach the batch verifier."""
+        verification so stale floods never reach the batch verifier.
+
+        `tctx` is the sender's propagated trace context: the receive
+        span (and everything nested under it — verification, the
+        store_external edge, threshold aggregation) joins the sender's
+        duty trace. A corrupted/garbage tctx decodes to None and the
+        span falls back to a fresh duty-rooted root — frame chaos must
+        never crash the receive path."""
+        from charon_tpu.app.tracer import parse_ctx, span
+
         if self.gater is not None and not self.gater(duty):
             self.dropped_stale += 1
             return
-        if self.verifier is not None:
-            check = getattr(self.verifier, "verify_async", None)
-            ok = (
-                await check(duty, signed_set)
-                if check is not None
-                else self.verifier.verify(duty, signed_set)
-            )
-            if not ok:
-                return  # drop invalid sets (logged/tracked in the full stack)
-        for sub in self._subs:
-            await sub(duty, signed_set)
+        with span(
+            "parsigex.receive",
+            duty=duty,
+            tracer=self.tracer,
+            remote=parse_ctx(tctx),
+            pubkeys=len(signed_set),
+        ):
+            if self.verifier is not None:
+                check = getattr(self.verifier, "verify_async", None)
+                ok = (
+                    await check(duty, signed_set)
+                    if check is not None
+                    else self.verifier.verify(duty, signed_set)
+                )
+                if not ok:
+                    return  # drop invalid sets (logged/tracked in the full stack)
+            for sub in self._subs:
+                await sub(duty, signed_set)

@@ -192,6 +192,9 @@ class DutyReport:
     # cluster threshold — populated even when the duty as a whole
     # succeeded for the other validators (partial success)
     failed_pubkeys: dict[PubKey, Reason] = field(default_factory=dict)
+    # the duty's deterministic trace id (app/tracer.duty_trace_id):
+    # the report's handle into /debug/traces and /debug/duty/<slot>
+    trace_id: str = ""
 
 
 ReportSub = Callable[[DutyReport], Awaitable[None] | None]
@@ -435,6 +438,8 @@ class Tracker:
         if pubkey_failures:
             self.pubkey_failures_total[duty.type] += len(pubkey_failures)
 
+        from charon_tpu.app.tracer import duty_trace_id  # lazy: core !-> app
+
         report = DutyReport(
             duty=duty,
             success=success,
@@ -447,6 +452,7 @@ class Tracker:
             unexpected_shares=dict(unexpected),
             inconsistent_pubkeys=inconsistent,
             failed_pubkeys=pubkey_failures,
+            trace_id=duty_trace_id(duty),
         )
         for sub in self._subs:
             res = sub(report)

@@ -20,6 +20,33 @@ from typing import Awaitable, Callable, Sequence
 WireOption = Callable[[str, Callable], Callable]
 
 
+def tracing(tracer=None) -> WireOption:
+    """wire() option (sibling of app/metrics.instrument and
+    core/tracker.tracking): every subscription edge runs inside a span
+    rooted at the DETERMINISTIC duty trace id (app/tracer.duty_trace_id),
+    so Scheduler→Fetcher→Consensus→DutyDB→ValidatorAPI→ParSigDB→ParSigEx
+    →SigAgg→AggSigDB→Broadcaster each contribute one nested span per
+    duty, and spans recorded on different nodes merge into one
+    cross-node trace (ref: core/tracing.go + core.WithTracing,
+    app/app.go:569). Attrs: duty, slot, duty type, and the pubkey count
+    of dict-shaped payloads (duty-set fan-in width)."""
+
+    def option(name: str, fn: Callable) -> Callable:
+        async def wrapped(duty, *args, **kwargs):
+            # lazy: core must not import app at module load
+            from charon_tpu.app.tracer import span
+
+            attrs = {"duty_type": str(getattr(duty, "type", ""))}
+            if args and hasattr(args[0], "keys"):
+                attrs["pubkeys"] = len(args[0])
+            with span(name, duty=duty, tracer=tracer, **attrs):
+                return await fn(duty, *args, **kwargs)
+
+        return wrapped
+
+    return option
+
+
 def wire(
     *,
     scheduler,

@@ -28,14 +28,20 @@ class TcpParSigTransport:
     def attach(self, parsigex) -> None:
         self.local = parsigex
 
-    async def send(self, from_share_idx: int, duty, signed_set) -> None:
+    async def send(
+        self, from_share_idx: int, duty, signed_set, tctx=None
+    ) -> None:
+        # trace context rides the frame so peer-node spans join the
+        # sender's duty trace (ref: OTel ctx in the p2p envelopes)
         await self.node.broadcast(
-            PARSIGEX_PROTOCOL, {"duty": duty, "set": signed_set}
+            PARSIGEX_PROTOCOL, {"duty": duty, "set": signed_set, "tctx": tctx}
         )
 
     async def _on_msg(self, from_idx: int, msg):
         if self.local is not None:
-            await self.local.receive(msg["duty"], msg["set"])
+            await self.local.receive(
+                msg["duty"], msg["set"], tctx=msg.get("tctx")
+            )
         return None
 
 
@@ -51,12 +57,17 @@ class TcpQbftNet:
         self.local = consensus
         return self.node.index
 
-    async def broadcast(self, from_idx: int, duty, msg, values) -> None:
+    async def broadcast(
+        self, from_idx: int, duty, msg, values, tctx=None
+    ) -> None:
         await self.node.broadcast(
-            QBFT_PROTOCOL, {"duty": duty, "msg": msg, "vals": values}
+            QBFT_PROTOCOL,
+            {"duty": duty, "msg": msg, "vals": values, "tctx": tctx},
         )
 
     async def _on_msg(self, from_idx: int, m):
         if self.local is not None:
-            self.local.deliver(m["duty"], m["msg"], m["vals"])
+            self.local.deliver(
+                m["duty"], m["msg"], m["vals"], tctx=m.get("tctx")
+            )
         return None

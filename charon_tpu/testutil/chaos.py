@@ -191,7 +191,9 @@ class ChaosParSigTransport:
     def restart(self, share_idx: int) -> None:
         self.part.restart(share_idx)
 
-    async def send(self, from_idx: int, duty, signed_set) -> None:
+    async def send(
+        self, from_idx: int, duty, signed_set, tctx: str | None = None
+    ) -> None:
         if from_idx in self.part.crashed:
             raise ConnectionError(f"chaos: node {from_idx} is crashed")
         failed: list[int] = []
@@ -214,23 +216,33 @@ class ChaosParSigTransport:
                 failed.append(dst)
                 continue
             payload = signed_set
+            frame_tctx = tctx
             if self._rng.random() < self.cfg.corrupt:
                 self.corrupted += 1
                 payload = {
                     pk: _corrupt_parsig(ps, self._rng)
                     for pk, ps in signed_set.items()
                 }
-            self._deliver(node, duty, payload)
+                # corruption hits the whole frame: the propagated trace
+                # context arrives as garbage too — receivers must fall
+                # back to a fresh duty-rooted span, never crash
+                frame_tctx = self._rng.randbytes(12).hex() + "-zz"
+            self._deliver(node, duty, payload, frame_tctx)
             if self._rng.random() < self.cfg.duplicate:
                 self.duplicated += 1
-                self._deliver(node, duty, payload)
+                self._deliver(node, duty, payload, frame_tctx)
         if failed:
             raise ConnectionError(
                 f"chaos: delivery to peers {failed} failed"
             )
 
-    def _deliver(self, node, duty, signed_set) -> None:
+    def _deliver(self, node, duty, signed_set, tctx=None) -> None:
         async def run():
+            # simulated network boundary: the delivery task inherits the
+            # sender's contextvars — detach so trace context propagates
+            # only via the frame's tctx (app/tracer.detached)
+            from charon_tpu.app.tracer import detached
+
             roll = self._rng.random()
             if roll < self.cfg.reorder + self.cfg.delay:
                 self.delayed += 1
@@ -240,7 +252,8 @@ class ChaosParSigTransport:
             if node.share_idx in self.part.crashed:
                 return  # crashed while the frame was in flight
             try:
-                await node.receive(duty, signed_set)
+                with detached():
+                    await node.receive(duty, signed_set, tctx=tctx)
             except Exception:  # noqa: BLE001 — receiver faults stay local
                 pass
 
@@ -270,7 +283,9 @@ class ChaosMsgNet:
         self.nodes.append(node)
         return len(self.nodes) - 1
 
-    async def broadcast(self, from_idx: int, duty, msg, values) -> None:
+    async def broadcast(
+        self, from_idx: int, duty, msg, values, tctx: str | None = None
+    ) -> None:
         if from_idx in self.part.crashed:
             return
         for node in self.nodes:
@@ -285,15 +300,21 @@ class ChaosMsgNet:
                 continue
             if self._rng.random() < self.cfg.reorder + self.cfg.delay:
                 self.delayed += 1
-                self._late(node, duty, msg, values)
+                self._late(node, duty, msg, values, tctx)
                 continue
-            node.deliver(duty, msg, values)
+            from charon_tpu.app.tracer import detached
 
-    def _late(self, node, duty, msg, values) -> None:
+            with detached():
+                node.deliver(duty, msg, values, tctx=tctx)
+
+    def _late(self, node, duty, msg, values, tctx=None) -> None:
         async def run():
+            from charon_tpu.app.tracer import detached
+
             await asyncio.sleep(self._rng.uniform(0.0, self.cfg.delay_max))
             if node.node_idx not in self.part.crashed:
-                node.deliver(duty, msg, values)
+                with detached():
+                    node.deliver(duty, msg, values, tctx=tctx)
 
         task = asyncio.create_task(run())
         self._tasks.add(task)

@@ -25,7 +25,7 @@ from charon_tpu.core.sigagg import SigAgg
 from charon_tpu.core.tracker import Tracker, tracking
 from charon_tpu.core.types import PubKey, pubkey_from_bytes
 from charon_tpu.core.validatorapi import ValidatorAPI
-from charon_tpu.core.wire import wire
+from charon_tpu.core.wire import tracing, wire
 from charon_tpu.eth2util.signing import ForkInfo
 from charon_tpu.testutil.beaconmock import BeaconMock
 from charon_tpu.testutil.validatormock import ValidatorMock
@@ -81,6 +81,24 @@ class SimCluster:
         if self.partitioner is not None:
             self.partitioner.heal()
 
+    def close(self) -> None:
+        """Release per-node resources (crypto-plane pools, trace JSONL
+        handles) — tracing/crypto_plane builds should call this."""
+        for node in self.nodes:
+            if node.crypto_plane is not None:
+                node.crypto_plane.close()
+            if node.tracer is not None:
+                node.tracer.close()
+
+    def trace_paths(self) -> list[str]:
+        """Per-node span JSONL export paths (tracing builds with a
+        trace_dir); merge with app/tracer.merge_jsonl."""
+        return [
+            node.tracer.jsonl_path
+            for node in self.nodes
+            if node.tracer is not None and node.tracer.jsonl_path
+        ]
+
 
 @dataclass
 class SimNode:
@@ -96,6 +114,57 @@ class SimNode:
     consensus: ConsensusController
     inclusion: InclusionChecker | None = None
     tracker: Tracker | None = None
+    tracer: object | None = None  # app/tracer.Tracer (tracing=True builds)
+    crypto_plane: object | None = None  # SlotCoalescer (crypto_plane=True)
+
+
+class SimHostPlane:
+    """Stand-in device plane for the SlotCoalescer in observability
+    simnet runs: the DECODE stage upstream is the real pure-python
+    point decompression + hash-to-curve (it already rejects malformed
+    encodings), while the device program itself is a wall-clock sleep —
+    the same isolation bench_hostplane.SimPlane uses, so tracing tests
+    run jax-free. Implements the packed two-stage API so the pipelined
+    pack stage (and its span) engages. NOT a verifier: decode-valid
+    lanes all pass, so only wire it where a test doesn't rely on
+    signature rejection."""
+
+    def __init__(self, t: int, device_s: float = 0.002) -> None:
+        self.t = t
+        self.device_s = device_s
+
+    def verify_host(self, pks, msgs, sigs):
+        import time as _time
+
+        _time.sleep(self.device_s)
+        return [True] * len(pks)
+
+    def recombine_host(self, pubshares, msgs, partials, group_pks, indices):
+        raise NotImplementedError("verify-only sim plane")
+
+    # packed two-stage API (core/cryptoplane._plane_has_packed_api)
+    def pack_verify_inputs(self, pks, msgs, sigs):
+        import numpy as np
+
+        return (np.ones(len(pks), dtype=bool),)  # live mask only
+
+    def make_lane_rand(self, n):
+        return None
+
+    def verify_packed(self, arrays, rand, n):
+        import time as _time
+
+        _time.sleep(self.device_s)
+        return [True] * n
+
+    def pack_inputs(self, *a):
+        raise NotImplementedError("verify-only sim plane")
+
+    def make_rand(self, n):
+        return None
+
+    def recombine_packed(self, *a):
+        raise NotImplementedError("verify-only sim plane")
 
 
 def build_cluster(
@@ -109,6 +178,9 @@ def build_cluster(
     wire_vmock: bool = True,
     protocol_prefs: list[list[str]] | None = None,
     chaos=None,  # testutil.chaos.ChaosConfig: seeded fault injection
+    tracing_on: bool = False,
+    trace_dir: str | None = None,
+    crypto_plane: bool = False,
 ) -> SimCluster:
     """Create keys and wire n in-process nodes (ref: app/app.go simnet +
     cluster/test_cluster.go generator, redesigned for asyncio).
@@ -116,7 +188,16 @@ def build_cluster(
     With `chaos`, the cluster is built on the fault-injection plane:
     chaos transports for parsig exchange and QBFT messages, a ChaosBeacon
     around the shared mock, and a Partitioner for crash/restart and
-    partition/heal control (ISSUE 2 tentpole)."""
+    partition/heal control (ISSUE 2 tentpole).
+
+    With `tracing_on`, every node gets its OWN app/tracer.Tracer wired
+    as a wire() option plus transport-frame trace-context propagation
+    (ISSUE 4) — spans land per node as they would across real machines;
+    `trace_dir` additionally exports per-node JSONL for the cross-node
+    merge. `crypto_plane` routes inbound parsig verification through a
+    SlotCoalescer over SimHostPlane so duty traces carry real
+    decode/pack/device stage spans without jax; call cluster.close()
+    when done."""
     impl = tbls.get_implementation()
 
     group_pubkeys: list[PubKey] = []
@@ -201,6 +282,9 @@ def build_cluster(
                 protocol_prefs=(
                     protocol_prefs[i - 1] if protocol_prefs else None
                 ),
+                tracing_on=tracing_on,
+                trace_dir=trace_dir,
+                crypto_plane=crypto_plane,
             )
         )
     return cluster
@@ -215,9 +299,33 @@ def _build_node(
     wire_vmock: bool = True,
     prio_fabric=None,
     protocol_prefs: list[str] | None = None,
+    tracing_on: bool = False,
+    trace_dir: str | None = None,
+    crypto_plane: bool = False,
 ) -> SimNode:
     beacon = cluster.beacon
     fork = cluster.fork
+
+    node_tracer = None
+    if tracing_on:
+        from charon_tpu.app.tracer import Tracer
+
+        jsonl = (
+            f"{trace_dir}/node{share_idx}.jsonl" if trace_dir else None
+        )
+        node_tracer = Tracer(jsonl_path=jsonl)
+
+    plane = None
+    if crypto_plane:
+        from charon_tpu.app.tracer import plane_span_bridge
+        from charon_tpu.core.cryptoplane import SlotCoalescer
+
+        plane = SlotCoalescer(
+            SimHostPlane(cluster.t),
+            window=0.005,
+            decode_workers=2,
+            stats_hook=plane_span_bridge(node_tracer),
+        )
 
     dutydb = DutyDB()
     parsigdb = ParSigDB(threshold=cluster.t)
@@ -230,7 +338,13 @@ def _build_node(
         from charon_tpu.core.consensus_qbft import QBFTConsensus
 
         consensus = ConsensusController(
-            QBFTConsensus(qbft_net, cluster.n, round_timeout=0.3, timer="inc")
+            QBFTConsensus(
+                qbft_net,
+                cluster.n,
+                round_timeout=0.3,
+                timer="inc",
+                tracer=node_tracer,
+            )
         )
         # echo stays registered as a switchable alternate so priority
         # negotiation can change the protocol mid-run
@@ -243,10 +357,18 @@ def _build_node(
         fork=fork,
         slots_per_epoch=spe,
     )
-    verifier = Eth2Verifier(fork, cluster.pubshares_by_idx, spe)
+    verifier = Eth2Verifier(
+        fork, cluster.pubshares_by_idx, spe, plane=plane
+    )
     # clock enables the deadline-aware resend when a chaos transport
     # (or a real p2p link) raises on send
-    parsigex = ParSigEx(share_idx, transport, verifier, clock=beacon.clock())
+    parsigex = ParSigEx(
+        share_idx,
+        transport,
+        verifier,
+        clock=beacon.clock(),
+        tracer=node_tracer,
+    )
     scheduler = Scheduler(
         beacon,
         beacon.clock(),
@@ -278,6 +400,11 @@ def _build_node(
         threshold=cluster.t,
     )
 
+    options = [tracking(tracker), spawn_fetch]
+    if node_tracer is not None:
+        # same wire option as production (app/run.py): duty-rooted span
+        # per workflow edge, recorded into THIS node's tracer
+        options.insert(0, tracing(node_tracer))
     wire(
         scheduler=scheduler,
         fetcher=fetcher,
@@ -289,7 +416,7 @@ def _build_node(
         sigagg=sigagg,
         aggsigdb=aggsigdb,
         broadcaster=bcast,
-        options=[tracking(tracker), spawn_fetch],
+        options=options,
     )
     # fetcher pulls the aggregated randao from aggsigdb
     fetcher.register_agg_sig_db(aggsigdb.await_)
@@ -360,4 +487,6 @@ def _build_node(
         consensus=consensus,
         inclusion=inclusion,
         tracker=tracker,
+        tracer=node_tracer,
+        crypto_plane=plane,
     )

@@ -7,6 +7,7 @@
 #   ./ci.sh full      # both tiers
 #   ./ci.sh chaos     # seeded chaos scenarios only (subset of fast)
 #   ./ci.sh hostplane # event-loop-stall regression guard (subset of fast)
+#   ./ci.sh obs       # observability gate: monitoring endpoint + span export
 #
 # Every tier pins JAX to CPU (the canonical test env; TPU runs go
 # through bench.py / the dryrun) and a fixed PYTHONHASHSEED so the
@@ -29,9 +30,12 @@ case "$TIER" in
     # scenario suite under its fixed seed (tests/test_chaos_scenarios.py
     # SEED) — the -m default in pytest.ini already deselects slow —
     # plus the hostplane smoke (ISSUE 3): event-loop-stall regressions
-    # in the pipelined crypto coalescer fail the fast tier.
+    # in the pipelined crypto coalescer fail the fast tier — and the
+    # obs gate's fast subset (ISSUE 4): a 1-duty simnet must export
+    # duty-rooted spans through the monitoring endpoint.
     "${PYTEST[@]}" tests/ -m 'not slow' --continue-on-collection-errors
-    exec python bench_hostplane.py --smoke
+    python bench_hostplane.py --smoke
+    exec python obs_check.py --fast
     ;;
   hostplane)
     # Wall-clock budget: ~30 s. Tiny shapes, CPU, no jax: asserts the
@@ -51,7 +55,19 @@ case "$TIER" in
     # tier gates on); run when touching kernel families or before
     # cutting a round record.
     "${PYTEST[@]}" tests/ -m 'slow or not slow' --continue-on-collection-errors
-    exec python bench_hostplane.py --smoke
+    python bench_hostplane.py --smoke
+    exec python obs_check.py
+    ;;
+  obs)
+    # Wall-clock budget: ~1 min. Boots the monitoring endpoint over a
+    # 4-node simnet (jax-free SimHostPlane device), completes 2 duties,
+    # scrapes /metrics + /debug/traces + /debug/duty/<slot>, and
+    # asserts non-empty span export, per-step latency histograms, and
+    # the cross-node JSONL merge (one duty-rooted trace per duty, all
+    # wire edges + cryptoplane stages, no orphans). Runs the tracing/
+    # endpoint test files first for the unit-level failures.
+    "${PYTEST[@]}" tests/test_tracing_wire.py tests/test_obs_endpoint.py tests/test_tracer.py
+    exec python obs_check.py
     ;;
   chaos)
     # Wall-clock budget: ~2 min unloaded. The 8 seeded fault scenarios
@@ -61,7 +77,7 @@ case "$TIER" in
     exec "${PYTEST[@]}" tests/test_chaos_scenarios.py tests/test_retry_backoff.py
     ;;
   *)
-    echo "usage: $0 [fast|slow|full|chaos|hostplane]" >&2
+    echo "usage: $0 [fast|slow|full|chaos|hostplane|obs]" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,144 @@
+"""Observability surfaces of the duty-rooted tracing plane (ISSUE 4):
+the /debug/duty/<slot> timeline endpoint, trace ids stamped into log
+records, per-step latency histograms and the slow-duty detector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from charon_tpu.app import log, tracer
+from charon_tpu.app.metrics import (
+    ClusterMetrics,
+    SlowDutyDetector,
+    serve_monitoring,
+    span_metrics,
+)
+from charon_tpu.core.types import Duty, DutyType
+
+
+def _record_duty(t: tracer.Tracer, duty: Duty) -> None:
+    with tracer.span("fetcher.fetch", duty=duty, tracer=t):
+        with tracer.span("consensus.propose", tracer=t):
+            pass
+        with tracer.span("dutydb.store", tracer=t):
+            pass
+
+
+def test_debug_duty_endpoint_timeline_and_404():
+    async def run():
+        t = tracer.Tracer()
+        duty = Duty(slot=17, type=DutyType.ATTESTER)
+        _record_duty(t, duty)
+        metrics = ClusterMetrics("0xdead", "test", "node0")
+        server = await serve_monitoring("127.0.0.1", 0, metrics, tracer=t)
+        port = server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+
+        def get(url):
+            with urllib.request.urlopen(url) as resp:
+                return resp.status, resp.read()
+
+        status, body = await asyncio.to_thread(get, f"{base}/debug/duty/17")
+        assert status == 200
+        (timeline,) = json.loads(body)
+        assert timeline["trace_id"] == tracer.duty_trace_id(duty)
+        assert timeline["duty"] == str(duty)
+        assert timeline["wall_us"] >= 0
+        names = [s["name"] for s in timeline["spans"]]
+        assert names[0] == "fetcher.fetch"
+        assert set(names) == {
+            "fetcher.fetch",
+            "consensus.propose",
+            "dutydb.store",
+        }
+        # nesting is depth-annotated in span order
+        depths = {s["name"]: s["depth"] for s in timeline["spans"]}
+        assert depths["fetcher.fetch"] == 0
+        assert depths["consensus.propose"] == 1
+
+        # plain-text waterfall
+        status, body = await asyncio.to_thread(
+            get, f"{base}/debug/duty/17?format=text"
+        )
+        assert status == 200
+        text = body.decode()
+        assert "fetcher.fetch" in text and "wall" in text and "#" in text
+
+        # unknown slot and malformed slot both 404
+        for bad in ("/debug/duty/999", "/debug/duty/notaslot"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                await asyncio.to_thread(get, base + bad)
+            assert exc.value.code == 404
+
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_log_records_carry_trace_id(caplog):
+    import logging
+
+    duty = Duty(slot=4, type=DutyType.PROPOSER)
+    t = tracer.Tracer()
+    with caplog.at_level(logging.INFO, logger="charon_tpu"):
+        with tracer.span("fetcher.fetch", duty=duty, tracer=t):
+            log.info("inside span", topic="test")
+        log.info("outside span", topic="test")
+        with tracer.span("fetcher.fetch", duty=duty, tracer=t):
+            log.info("explicit", topic="test", trace_id="mine")
+    inside, outside, explicit = [r.getMessage() for r in caplog.records][-3:]
+    assert f"trace_id={tracer.duty_trace_id(duty)}" in inside
+    assert "trace_id" not in outside
+    # explicit call-site field wins over the ambient span
+    assert "trace_id=mine" in explicit
+
+
+def test_span_metrics_step_latency_histogram():
+    metrics = ClusterMetrics("0xdead", "test", "node0")
+    t = tracer.Tracer()
+    t.hooks.append(span_metrics(metrics))
+    duty = Duty(slot=2, type=DutyType.ATTESTER)
+    _record_duty(t, duty)
+    rendered = metrics.render().decode()
+    assert (
+        'core_step_latency_seconds_count{cluster_hash="0xdead",'
+        in rendered
+    )
+    for step in ("fetcher.fetch", "consensus.propose", "dutydb.store"):
+        assert f'step="{step}"' in rendered
+
+
+def test_slow_duty_detector():
+    metrics = ClusterMetrics("0xdead", "test", "node0")
+    det = SlowDutyDetector(metrics)
+    t = tracer.Tracer()
+    t.hooks.append(det.observe)
+    duty = Duty(slot=30, type=DutyType.ATTESTER)
+    _record_duty(t, duty)
+
+    # generous budget: not slow
+    wall = det.finalize(duty, budget=60.0)
+    assert wall is not None and wall >= 0
+    assert det.slow_total == 0
+    # state popped: a second finalize sees no spans
+    assert det.finalize(duty, budget=60.0) is None
+
+    # sub-zero budget trip: re-record and finalize with a tiny budget
+    _record_duty(t, duty)
+    wall = det.finalize(duty, budget=1e-9)
+    assert wall is not None
+    assert det.slow_total == 1
+    assert det.last["slow"] is True
+    rendered = metrics.render().decode()
+    assert "core_duty_slow_total" in rendered
+    assert "core_duty_wall_seconds" in rendered
+    # duties with no spans at all never flag
+    assert det.finalize(Duty(slot=31, type=DutyType.ATTESTER), 1e-9) is None
+    assert det.slow_total == 1

@@ -1,0 +1,294 @@
+"""Duty-rooted distributed tracing across the wire (ISSUE 4 tentpole).
+
+Covers: one span per wire edge per duty with correct parentage
+(core/wire.tracing), the cross-node merge of per-node JSONL exports
+into one trace per duty via the deterministic duty trace ids, and
+trace-context round-trips through transport frames — including a
+corrupted-frame chaos transport, which must fall back to a fresh
+duty-rooted root span without ever crashing the receive path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.app import tracer
+from charon_tpu.core import qbft
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.core.wire import tracing
+from charon_tpu.tbls.python_impl import PythonImpl
+
+# the wire edges every completed attestation duty must traverse,
+# in pipeline order (core/wire.wire subscription graph)
+WIRE_EDGES = [
+    "fetcher.fetch",
+    "consensus.propose",
+    "dutydb.store",
+    "parsigdb.store_internal",
+    "parsigex.broadcast",
+    "parsigdb.store_external",
+    "sigagg.aggregate",
+    "aggsigdb.store",
+    "broadcaster.broadcast",
+]
+
+
+def test_every_wire_edge_produces_one_span_with_parentage():
+    """A duty flowing through a chain of wrapped edges leaves exactly
+    one span per edge, each nested under the edge that invoked it, all
+    in the duty's deterministic trace."""
+    t = tracer.Tracer()
+    opt = tracing(t)
+    duty = Duty(slot=11, type=DutyType.ATTESTER)
+
+    async def leaf(d, *args):
+        return None
+
+    fn = leaf
+    for name in reversed(WIRE_EDGES):
+        wrapped_next = opt(name, fn)
+
+        async def body(d, *args, _n=wrapped_next):
+            return await _n(d, {"0xab": object()})
+
+        fn = body
+
+    asyncio.run(fn(duty))
+
+    spans = t.dump()
+    by_name = {s["name"]: s for s in spans}
+    assert sorted(by_name) == sorted(WIRE_EDGES)
+    assert len(spans) == len(WIRE_EDGES)  # exactly one span per edge
+    tid = tracer.duty_trace_id(duty)
+    for s in spans:
+        assert s["trace_id"] == tid
+        assert s["attrs"]["duty"] == str(duty)
+        assert s["attrs"]["slot"] == duty.slot
+        assert s["attrs"]["pubkeys"] == 1
+    # parentage follows the pipeline: each edge nests under its caller
+    assert by_name[WIRE_EDGES[0]]["parent_id"] == ""
+    for parent, child in zip(WIRE_EDGES, WIRE_EDGES[1:]):
+        assert by_name[child]["parent_id"] == by_name[parent]["span_id"]
+
+
+def test_parsigex_receive_joins_remote_trace():
+    """A valid propagated frame context parents the receive span under
+    the sender's broadcast span — cross-node parentage."""
+    from charon_tpu.core.parsigex import MemTransport, ParSigEx
+
+    t = tracer.Tracer()
+    duty = Duty(slot=5, type=DutyType.ATTESTER)
+    psx = ParSigEx(1, MemTransport(), tracer=t)
+    remote_trace, remote_span = "ab" * 16, "cd" * 8
+
+    asyncio.run(
+        psx.receive(duty, {}, tctx=f"{remote_trace}-{remote_span}")
+    )
+    (s,) = t.dump()
+    assert s["name"] == "parsigex.receive"
+    assert s["trace_id"] == remote_trace
+    assert s["parent_id"] == remote_span
+
+
+@pytest.mark.parametrize(
+    "garbage",
+    [
+        "",
+        "zz",
+        "nothex" * 8 + "-" + "zz" * 8,
+        "ab" * 16,
+        42,
+        b"ab" * 16,
+        None,
+        # right lengths but not strict hex: int(x, 16) would accept
+        # these prefix/whitespace forms — parse_ctx must not
+        "0x" + "ab" * 15 + "-" + "0x" + "cd" * 7,
+        " " + "ab" * 15 + "a-" + "+" + "cd" * 7 + "c",
+    ],
+)
+def test_parsigex_receive_corrupt_ctx_falls_back_to_root(garbage):
+    """ANY malformed trace context decodes to None: the receive span
+    roots a fresh duty trace and delivery proceeds."""
+    from charon_tpu.core.parsigex import MemTransport, ParSigEx
+
+    t = tracer.Tracer()
+    duty = Duty(slot=6, type=DutyType.ATTESTER)
+    psx = ParSigEx(1, MemTransport(), tracer=t)
+    delivered = []
+
+    async def sub(d, s):
+        delivered.append(d)
+
+    psx.subscribe(sub)
+    asyncio.run(psx.receive(duty, {}, tctx=garbage))
+    assert delivered == [duty]
+    (s,) = t.dump()
+    assert s["parent_id"] == ""
+    assert s["trace_id"] == tracer.duty_trace_id(duty)
+
+
+def test_chaos_corrupted_frame_ctx_never_crashes():
+    """Through the chaos transport with corrupt=1.0 every frame's trace
+    context arrives mangled: receivers must record fresh duty-rooted
+    root spans and never raise."""
+    from charon_tpu.core.parsigex import ParSigEx
+    from charon_tpu.testutil.chaos import ChaosConfig, ChaosParSigTransport
+
+    async def run():
+        transport = ChaosParSigTransport(ChaosConfig(seed=7, corrupt=1.0))
+        tracers = [tracer.Tracer(), tracer.Tracer()]
+        nodes = [
+            ParSigEx(i + 1, transport, tracer=tracers[i]) for i in range(2)
+        ]
+        duty = Duty(slot=3, type=DutyType.ATTESTER)
+        with tracer.span("parsigex.broadcast", duty=duty, tracer=tracers[0]):
+            await transport.send(1, duty, {}, tctx=tracer.encode_ctx())
+        await asyncio.sleep(0.1)  # chaos delivery tasks
+        assert transport.corrupted >= 1
+        recv = [s for s in tracers[1].dump() if s["name"] == "parsigex.receive"]
+        assert recv, "corrupted frame was not delivered"
+        for s in recv:
+            # fallback: fresh duty-rooted root, NOT the sender's span
+            assert s["parent_id"] == ""
+            assert s["trace_id"] == tracer.duty_trace_id(duty)
+        assert nodes is not None
+
+    asyncio.run(run())
+
+
+def test_qbft_deliver_ctx_propagation_and_fallback():
+    """QBFT frames carry trace context; a follower's message-handling
+    span joins the sender's trace, and garbage context falls back to a
+    fresh duty-rooted root without crashing delivery."""
+    from charon_tpu.core.consensus_qbft import MemMsgNet, QBFTConsensus
+
+    async def run():
+        t = tracer.Tracer()
+        node = QBFTConsensus(MemMsgNet(), nodes=4, tracer=t)
+        duty = Duty(slot=9, type=DutyType.ATTESTER)
+        msg = qbft.Msg(
+            type=qbft.MsgType.PRE_PREPARE,
+            instance=duty,
+            source=1,
+            round=1,
+            value=b"\x01" * 32,
+        )
+        node.deliver(duty, msg, {}, tctx="ab" * 16 + "-" + "cd" * 8)
+        node.deliver(duty, msg, {}, tctx="garbage")
+        spans = [s for s in t.dump() if s["name"] == "qbft.deliver"]
+        assert len(spans) == 2
+        assert spans[0]["trace_id"] == "ab" * 16
+        assert spans[0]["parent_id"] == "cd" * 8
+        assert spans[0]["attrs"]["msg_type"] == "PRE_PREPARE"
+        assert spans[1]["trace_id"] == tracer.duty_trace_id(duty)
+        assert spans[1]["parent_id"] == ""
+        node.trim(duty)
+
+    asyncio.run(run())
+
+
+# -- cross-node simnet merge --------------------------------------------------
+
+
+@pytest.fixture()
+def host_tbls():
+    try:
+        from charon_tpu.tbls.native_impl import NativeImpl
+
+        tbls.set_implementation(NativeImpl())
+    except ImportError:
+        tbls.set_implementation(PythonImpl())
+    yield
+    tbls.set_implementation(PythonImpl())
+
+
+def _completed_attester_slots(beacon, n: int) -> list[int]:
+    by_slot: dict[int, int] = {}
+    for a in beacon.attestations:
+        by_slot[a.data.slot] = by_slot.get(a.data.slot, 0) + 1
+    return sorted(s for s, c in by_slot.items() if c >= n)
+
+
+def test_simnet_cross_node_traces_merge(host_tbls, tmp_path):
+    """4 nodes, >= 2 attestation duties: per-node JSONL exports merge
+    into ONE duty-rooted trace per duty covering every wire edge plus
+    the crypto plane's decode/pack/device stages, with spans from all
+    4 nodes and no orphan parentage."""
+    from charon_tpu.testutil.simnet import build_cluster
+
+    cluster = build_cluster(
+        n=4,
+        t=3,
+        slot_duration=0.2,
+        tracing_on=True,
+        trace_dir=str(tmp_path),
+        crypto_plane=True,
+    )
+
+    async def drive():
+        tasks = [
+            asyncio.create_task(node.scheduler.run())
+            for node in cluster.nodes
+        ]
+        try:
+
+            async def enough():
+                while len(_completed_attester_slots(cluster.beacon, 4)) < 2:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(enough(), timeout=60)
+        finally:
+            for node in cluster.nodes:
+                node.scheduler.stop()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            # let in-flight crypto-plane flushes settle before close
+            await asyncio.sleep(0.1)
+
+    asyncio.run(drive())
+    cluster.close()
+
+    paths = cluster.trace_paths()
+    assert len(paths) == 4
+    per_node = [tracer.merge_jsonl([p]) for p in paths]
+    merged = tracer.merge_jsonl(paths)
+
+    slots = _completed_attester_slots(cluster.beacon, 4)[:2]
+    assert len(slots) == 2
+    for slot in slots:
+        duty = Duty(slot=slot, type=DutyType.ATTESTER)
+        tid = tracer.duty_trace_id(duty)
+        # ONE trace per duty: every span tagged with this duty carries
+        # the deterministic trace id, on every node
+        duty_spans = [
+            s for s in merged if s["attrs"].get("duty") == str(duty)
+        ]
+        assert duty_spans
+        assert {s["trace_id"] for s in duty_spans} == {tid}
+        trace = [s for s in merged if s["trace_id"] == tid]
+        names = {s["name"] for s in trace}
+        for edge in WIRE_EDGES:
+            assert edge in names, f"missing {edge} for slot {slot}"
+        # crypto-plane stages bridged into the duty trace
+        for stage in (
+            "cryptoplane.flush",
+            "cryptoplane.decode",
+            "cryptoplane.device",
+        ):
+            assert stage in names, f"missing {stage} for slot {slot}"
+        # all 4 nodes contributed spans to the SAME trace
+        for i, spans in enumerate(per_node):
+            assert any(
+                s["trace_id"] == tid for s in spans
+            ), f"node{i + 1} contributed no spans to slot {slot}"
+        # no orphans: every parent id resolves inside the merged trace
+        ids = {s["span_id"] for s in trace}
+        for s in trace:
+            assert s["parent_id"] == "" or s["parent_id"] in ids, (
+                f"orphan span {s['name']} in slot {slot}"
+            )
+        # timeline assembly works off the merged export too
+        timelines = tracer.duty_timeline(slot, spans=merged)
+        assert any(tl["trace_id"] == tid for tl in timelines)
