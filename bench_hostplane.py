@@ -20,6 +20,14 @@ and runs without jax — CPU-only, CI-safe. Real decode work is used:
 pure-python point decompression and hash-to-curve, the exact bigint
 work the decode pool exists to move off the loop.
 
+Decode A/B (ISSUE 5): `--decode-mode {python,device}` selects the
+coalescer's signature-decode rung for the phases above, and the bench
+always measures the decode stage's host CPU time for BOTH rungs over
+the same burst (pk/msg caches warm — the live regime where signature
+decompression dominates). With --decode-mode device (or --smoke) the
+run FAILS unless the device rung cuts decode host CPU by
+--assert-decode-ratio (default 5x), measured twice before concluding.
+
 `--smoke` (ci.sh fast tier) runs tiny shapes and FAILS (exit 1) when
 the stall improvement ratio drops below --assert-ratio or the overlap
 hits zero — the event-loop-stall regression guard.
@@ -33,12 +41,16 @@ import json
 import threading
 import time
 
+import numpy as np
+
 
 class SimPlane:
     """Wall-clock device stand-in: each flush 'executes' for device_s
     seconds and records its busy span. `busy` (threading.Event) lets the
     driver submit the next window precisely while a program is in
-    flight."""
+    flight. Exposes the packed AND parsed plane APIs (as fakes) so the
+    coalescer exercises its real pack stage and decode_mode=device
+    routing without jax."""
 
     def __init__(self, t: int, device_s: float):
         self.t = t
@@ -46,22 +58,52 @@ class SimPlane:
         self.spans: list[tuple[float, float]] = []
         self.busy = threading.Event()
 
-    def verify_host(self, pks, msgs, sigs, rng=None):
+    def _device(self, n: int):
         t0 = time.monotonic()
         self.busy.set()
         time.sleep(self.device_s)
         self.busy.clear()
         self.spans.append((t0, time.monotonic()))
+
+    def verify_host(self, pks, msgs, sigs, rng=None):
+        self._device(len(pks))
         return [True] * len(pks)
 
     def recombine_host(self, pubshares, msgs, partials, group_pks,
                        indices, rng=None):
-        t0 = time.monotonic()
-        self.busy.set()
-        time.sleep(self.device_s)
-        self.busy.clear()
-        self.spans.append((t0, time.monotonic()))
+        self._device(len(msgs))
         return [None] * len(msgs), [True] * len(msgs)
+
+    # -- packed / parsed fakes (lane counts only; live mask last) ---------
+
+    def pack_verify_inputs(self, pks, msgs, sigs):
+        return ("v", np.empty(len(pks)))
+
+    def pack_verify_inputs_parsed(self, pks, msgs, parsed):
+        return ("vp", np.empty(len(pks)))
+
+    def make_lane_rand(self, n: int, rng=None):
+        return n
+
+    def verify_packed(self, arrays, rand, n: int):
+        self._device(n)
+        return [True] * n
+
+    verify_packed_parsed = verify_packed
+
+    def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):
+        return ("r", np.empty(len(msgs)))
+
+    pack_inputs_parsed = pack_inputs
+
+    def make_rand(self, v: int, rng=None):
+        return v
+
+    def recombine_packed(self, args, rand, v: int):
+        self._device(v)
+        return [None] * v, [True] * v
+
+    recombine_packed_parsed = recombine_packed
 
 
 def _merge(spans):
@@ -121,7 +163,7 @@ async def _stall_probe(stop: asyncio.Event, interval: float = 0.001):
 
 async def run_phase(
     items, decode_workers: int, submissions: int, window: float,
-    device_s: float,
+    device_s: float, decode_mode: str = "python",
 ) -> dict:
     from charon_tpu.core.cryptoplane import SlotCoalescer
 
@@ -135,6 +177,7 @@ async def run_phase(
         window=window,
         decode_workers=decode_workers,
         stats_hook=stats.append,
+        decode_mode=decode_mode,
     )
     stop = asyncio.Event()
     probe = asyncio.create_task(_stall_probe(stop))
@@ -177,6 +220,10 @@ async def run_phase(
     device_spans = [s.device_span for s in stats if s.device_span is not None]
     return {
         "decode_workers": decode_workers,
+        "decode_mode": decode_mode,
+        "decode_device_lanes": sum(s.decode_device_lanes for s in stats),
+        "decode_python_lanes": sum(s.decode_python_lanes for s in stats),
+        "decode_cache_lookups": sum(s.decode_cache_lanes for s in stats),
         "lanes": len(items),
         "submissions": len(chunks) + 1,
         "flushes": coal.flushes,
@@ -196,16 +243,55 @@ async def run_phase(
 
 async def _measure(args, items):
     sync = await run_phase(
-        items, 0, args.submissions, args.window, args.device_seconds
+        items, 0, args.submissions, args.window, args.device_seconds,
+        args.decode_mode,
     )
     piped = await run_phase(
         items, args.decode_workers, args.submissions, args.window,
-        args.device_seconds,
+        args.device_seconds, args.decode_mode,
     )
     ratio = sync["loop_max_stall_seconds"] / max(
         piped["loop_max_stall_seconds"], 1e-6
     )
     return sync, piped, ratio
+
+
+def measure_decode_host(items, mode: str) -> float:
+    """Host CPU seconds (thread_time — scheduler noise excluded) the
+    decode stage spends on one burst under the given rung, pk/msg
+    caches warm: cluster pubshares are a static cached set and live
+    duty roots were hashed by earlier submissions in the slot, so what
+    this isolates is exactly the always-fresh SIGNATURE decompression
+    the device rung retires from the host (ISSUE 5)."""
+    from charon_tpu.core.cryptoplane import (
+        _decode_pubkey,
+        _decode_verify_lane,
+        _msg_point,
+        _parse_verify_lane,
+    )
+
+    for pk, root, _sig in items:
+        _decode_pubkey(pk)
+        _msg_point(root)
+    fn = _parse_verify_lane if mode == "device" else _decode_verify_lane
+    t0 = time.thread_time()
+    lanes = [fn(it) for it in items]
+    elapsed = time.thread_time() - t0
+    assert all(lane is not None for lane in lanes)
+    return elapsed
+
+
+def decode_ab(items) -> dict:
+    """The Round-7 A/B: decode-stage host CPU per burst, python rung vs
+    device rung (parse-only host work; field arithmetic on device)."""
+    py_s = measure_decode_host(items, "python")
+    dev_s = measure_decode_host(items, "device")
+    return {
+        "lanes": len(items),
+        "python_decode_host_seconds": round(py_s, 4),
+        "device_decode_host_seconds": round(dev_s, 6),
+        "decode_host_cpu_ratio": round(py_s / max(dev_s, 1e-9), 1),
+    }
 
 
 async def main(args) -> int:
@@ -252,6 +338,19 @@ async def main(args) -> int:
               f"(attempt {attempts + 1}/3, load transient?)")
         sync, piped, ratio = await _measure(args, items)
         attempts += 1
+    # decode-stage host CPU A/B (ISSUE 5) — measured twice before a
+    # verdict sticks (the gate below fails only if BOTH runs miss)
+    ab = decode_ab(items)
+    want_decode = args.assert_decode_ratio if (
+        args.smoke or args.decode_mode == "device"
+    ) else 0.0
+    decode_attempts = 1
+    while want_decode and ab["decode_host_cpu_ratio"] < want_decode \
+            and decode_attempts < 2:
+        print(f"# decode ratio {ab['decode_host_cpu_ratio']}x < "
+              f"{want_decode}x — remeasuring")
+        ab = decode_ab(items)
+        decode_attempts += 1
     report = {
         "bench": "hostplane",
         "smoke": args.smoke,
@@ -259,6 +358,7 @@ async def main(args) -> int:
         "pipelined": piped,
         "stall_improvement_ratio": round(ratio, 1),
         "measure_attempts": attempts,
+        "decode_ab": ab,
     }
     print(json.dumps(report, indent=2))
     print(
@@ -267,6 +367,19 @@ async def main(args) -> int:
         f"host/device overlap {piped['host_device_overlap_seconds'] * 1000:.0f} ms, "
         f"inflight depth {piped['max_inflight']}"
     )
+    print(
+        f"# decode host CPU/burst: python "
+        f"{ab['python_decode_host_seconds'] * 1000:.0f} ms -> device rung "
+        f"{ab['device_decode_host_seconds'] * 1000:.1f} ms "
+        f"({ab['decode_host_cpu_ratio']}x)"
+    )
+    if want_decode and ab["decode_host_cpu_ratio"] < want_decode:
+        print(
+            f"FAIL: device decode rung cut host CPU only "
+            f"{ab['decode_host_cpu_ratio']}x < {want_decode}x "
+            f"on {decode_attempts} attempts"
+        )
+        return 1
     if want:
         if ratio < want:
             print(
@@ -300,8 +413,17 @@ if __name__ == "__main__":
                     "0 (default) auto-calibrates to outlast the next "
                     "window's decode so the double-buffering "
                     "measurement engages")
+    ap.add_argument("--decode-mode", choices=("python", "device"),
+                    default="python",
+                    help="coalescer signature-decode rung for the "
+                    "stall/overlap phases; 'device' also gates on the "
+                    "decode host-CPU A/B ratio")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes + regression assertions (CI fast tier)")
     ap.add_argument("--assert-ratio", type=float, default=0.0,
                     help="fail unless stall improves by at least this factor")
+    ap.add_argument("--assert-decode-ratio", type=float, default=5.0,
+                    help="with --decode-mode device or --smoke: fail "
+                    "unless the device rung cuts decode-stage host CPU "
+                    "by at least this factor (ISSUE 5 acceptance)")
     raise SystemExit(asyncio.run(main(ap.parse_args())))

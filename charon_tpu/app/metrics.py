@@ -191,6 +191,47 @@ class ClusterMetrics:
             "Flushes whose host stages overlapped a device program "
             "still in flight (double-buffered windows)",
         )
+        # decode-source breakdown (ISSUE 5): where each flush's point
+        # decodes were served — LRU point-cache lookups (pubkeys,
+        # messages, pubshares) vs signature lanes decompressed on
+        # device (decode-fused flush programs) vs on host (python
+        # bigint rung)
+        self.plane_decode_lanes = counter(
+            "tpu_plane_decode_lanes_total",
+            "Point decodes per flush by source: cache = LRU point "
+            "lookups, device = signature lanes decompressed inside the "
+            "flush program, python = host bigint decompression",
+            ["source"],
+        )
+        self.plane_decode_mode = Gauge(
+            "tpu_plane_decode_mode",
+            "Decode rung that served the most recent flush "
+            "(1 = device decompression kernels, 0 = python host decode)",
+            labels,
+            registry=self.registry,
+        )
+        # tpu_impl point-cache efficiency, polled from the process-wide
+        # lru_cache counters at scrape time (monotonic, but exported as
+        # gauges because cache_info() owns the counter state)
+        self.point_cache_hits = Gauge(
+            "tpu_point_cache_hits",
+            "Cumulative lru_cache hits of the tpu_impl point caches, "
+            "by cache (pubkey decompression / message hash-to-curve)",
+            labels + ["cache"],
+            registry=self.registry,
+        )
+        self.point_cache_misses = Gauge(
+            "tpu_point_cache_misses",
+            "Cumulative lru_cache misses (cold decodes paid on host)",
+            labels + ["cache"],
+            registry=self.registry,
+        )
+        self.point_cache_size = Gauge(
+            "tpu_point_cache_entries",
+            "Current entries held by the tpu_impl point caches",
+            labels + ["cache"],
+            registry=self.registry,
+        )
         # duty-rooted tracing (ISSUE 4): per-step latency from span
         # ends plus the slow-duty detector's wall-time/budget verdicts
         self.step_latency = Histogram(
@@ -219,7 +260,26 @@ class ClusterMetrics:
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)
 
+    def observe_point_caches(self) -> None:
+        """Refresh the point-cache gauges from the tpu_impl lru_cache
+        counters. Only when tpu_impl is already imported — a scrape
+        must never pull the jax stack into a host-only process."""
+        import sys
+
+        impl = sys.modules.get("charon_tpu.tbls.tpu_impl")
+        if impl is None:
+            return
+        for name, cache in (
+            ("pubkey", impl._cached_pubkey_point),
+            ("message", impl._cached_msg_point),
+        ):
+            info = cache.cache_info()
+            self.labels(self.point_cache_hits, name).set(info.hits)
+            self.labels(self.point_cache_misses, name).set(info.misses)
+            self.labels(self.point_cache_size, name).set(info.currsize)
+
     def render(self) -> bytes:
+        self.observe_point_caches()
         return generate_latest(self.registry)
 
 

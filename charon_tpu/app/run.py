@@ -84,6 +84,11 @@ class Config:
     # only on a real accelerator backend (CPU test runs skip the
     # minutes-long pairing compiles), "on" forces, "off" disables
     crypto_plane_prewarm: str = "auto"
+    # signature-decode rung (ISSUE 5): "device" batches compressed-point
+    # decompression into the flush programs (ops/decompress.py),
+    # "python" keeps the host bigint decode, "auto" resolves to device
+    # on TPU backends only — python remains the degradation rung below
+    crypto_plane_decode: str = "auto"
     # OTLP/HTTP collector for workflow spans (ref: --jaeger-address,
     # app/app.go:1014-1027 wireTracing); "" disables export
     tracing_endpoint: str = ""
@@ -169,7 +174,9 @@ async def build_node(config: Config) -> Node:
     if config.use_tpu_tbls:
         from charon_tpu.tbls.tpu_impl import TPUImpl
 
-        tbls.set_implementation(_resilient_ladder(TPUImpl()))
+        tbls.set_implementation(
+            _resilient_ladder(TPUImpl(decode_mode=config.crypto_plane_decode))
+        )
         if config.crypto_plane != "off":
             import jax
 
@@ -191,6 +198,7 @@ async def build_node(config: Config) -> Node:
                     window_min=config.crypto_plane_window_min,
                     window_max=config.crypto_plane_window_max,
                     decode_workers=config.crypto_plane_decode_workers,
+                    decode_mode=config.crypto_plane_decode,
                 )
                 log.info(
                     "crypto plane installed",
@@ -198,6 +206,7 @@ async def build_node(config: Config) -> Node:
                     devices=n_devices,
                     window=config.crypto_plane_window,
                     decode_workers=config.crypto_plane_decode_workers,
+                    decode_mode=config.crypto_plane_decode,
                 )
     else:
         # host path: prefer the native C++ backend — pure-Python pairing
@@ -289,6 +298,20 @@ async def build_node(config: Config) -> Node:
             metrics.labels(metrics.plane_inflight).set(s.inflight)
             if s.inflight >= 2:
                 metrics.labels(metrics.plane_overlapped).inc()
+            # decode-source breakdown (ISSUE 5): cache lookups vs
+            # device-decompressed vs host-decoded signature lanes
+            for source, count in (
+                ("cache", s.decode_cache_lanes),
+                ("device", s.decode_device_lanes),
+                ("python", s.decode_python_lanes),
+            ):
+                if count:
+                    metrics.labels(
+                        metrics.plane_decode_lanes, source
+                    ).inc(count)
+            metrics.labels(metrics.plane_decode_mode).set(
+                1 if s.decode_mode == "device" else 0
+            )
 
         # bridge each flush's decode/pack/device stages into tracer
         # spans joined to the duty traces that rode the flush (ISSUE 4

@@ -160,6 +160,15 @@ def build_parser() -> argparse.ArgumentParser:
         "pre-warms only on a TPU backend (CPU compiles take minutes)",
     )
     runp.add_argument(
+        "--crypto-plane-decode",
+        choices=["auto", "device", "python"],
+        default=_env_default("crypto-plane-decode", "") or "auto",
+        help="signature-decode rung: device batches point "
+        "decompression into the flush programs (ops/decompress.py), "
+        "python keeps the host bigint path, auto = device on TPU "
+        "backends only (docs/operations.md 'Crypto-plane tuning')",
+    )
+    runp.add_argument(
         "--relay",
         default=_env_default("relay", ""),
         help="host:port of a charon-tpu relay for NAT fallback dials",
@@ -471,6 +480,13 @@ def cmd_run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.crypto_plane_decode not in ("auto", "device", "python"):
+        print(
+            f"--crypto-plane-decode {args.crypto_plane_decode!r}: "
+            "must be auto, device, or python",
+            file=sys.stderr,
+        )
+        return 2
 
     rc = _init_featureset(args)
     if rc:
@@ -511,6 +527,7 @@ def cmd_run(args) -> int:
         crypto_plane_window=args.crypto_plane_window,
         crypto_plane_decode_workers=args.crypto_plane_decode_workers,
         crypto_plane_prewarm=args.crypto_plane_prewarm,
+        crypto_plane_decode=args.crypto_plane_decode,
         tracing_endpoint=args.tracing_endpoint,
         tracing_jsonl=args.tracing_jsonl,
         relay_addr=args.relay,

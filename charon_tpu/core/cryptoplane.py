@@ -69,6 +69,23 @@ from typing import Sequence
 from charon_tpu.crypto import g1g2
 from charon_tpu.tbls import TblsError
 
+try:
+    # the parse half of ops/decompress is pure host code, but the ops
+    # PACKAGE init configures jax (x64) on import — on a jax-less host
+    # the device decode rung is simply unavailable and the coalescer
+    # stays on the python rung (the PR 2 ladder's floor).
+    from charon_tpu.ops import decompress as _dec
+except ImportError:  # pragma: no cover — jax not installed
+    _dec = None
+
+
+class _ParsedPointNA:
+    """Sentinel parsed-lane type for jax-less hosts: nothing is ever an
+    instance, so every isinstance() site degrades to the point path."""
+
+
+_PARSED_T = _dec.ParsedPoint if _dec is not None else _ParsedPointNA
+
 
 @dataclass
 class _VerifyJob:
@@ -116,6 +133,14 @@ class FlushStats:
     padded_lanes: int | None  # total lanes after bucket padding
     decode_queue_seconds: tuple[float, ...]  # decode-pool queue delays
     fallback: bool = False  # served by the python-spec rung
+    # decode-source breakdown of this flush (ISSUE 5): point lookups
+    # served by the tpu_impl LRU caches (pubkeys/messages/pubshares) vs
+    # signature lanes decompressed on device (parsed lanes shipped to a
+    # decode-fused program) vs on host (python bigint decode)
+    decode_mode: str = "python"  # decode rung that served the flush
+    decode_cache_lanes: int = 0
+    decode_device_lanes: int = 0
+    decode_python_lanes: int = 0
     # wall-clock stage windows of THIS flush's pipeline pass
     decode_spans: tuple[tuple[float, float], ...] = ()  # per decode chunk
     pack_span: tuple[float, float] | None = None
@@ -151,6 +176,37 @@ def _decode_verify_lane(item):
     pk, root, sig = item
     try:
         return (_decode_pubkey(pk), _msg_point(root), _decode_sig(sig))
+    except (TblsError, ValueError):
+        return None
+
+
+def _parse_verify_lane(item):
+    """decode_mode=device twin of _decode_verify_lane: the pubkey and
+    message still come from the host LRU caches (cache-hit dominated),
+    but the signature is only PARSED (flags + range checks, no field
+    arithmetic) — the Fp2 sqrt, sign selection, on-curve and subgroup
+    checks run batched on device inside the flush program. Lanes the
+    parse already rejects (malformed flags, x >= p, infinity) fail on
+    host and never ship."""
+    pk, root, sig = item
+    try:
+        pk_pt, msg_pt = _decode_pubkey(pk), _msg_point(root)
+    except (TblsError, ValueError):
+        return None
+    parsed = _dec.parse_g2_lane(sig)
+    if not parsed.ok or parsed.infinity:
+        return None
+    return (pk_pt, msg_pt, parsed)
+
+
+def _lane_to_points(lane):
+    """Parsed verify lane -> point triple on the python rung (device
+    decode unavailable / degraded). Point lanes pass through; a parsed
+    signature that fails host decompression turns the lane into None."""
+    if lane is None or not isinstance(lane[2], _PARSED_T):
+        return lane
+    try:
+        return (lane[0], lane[1], _decode_sig(lane[2].raw))
     except (TblsError, ValueError):
         return None
 
@@ -196,6 +252,7 @@ class SlotCoalescer:
         window_max: float = 0.08,
         decode_workers: int = 4,
         stats_hook=None,
+        decode_mode: str = "auto",
     ):
         import concurrent.futures
 
@@ -204,6 +261,18 @@ class SlotCoalescer:
         self.window_min = min(window_min, window)
         self.window_max = max(window_max, window)
         self.decode_workers = decode_workers
+        # signature-decode routing (ISSUE 5): "device" parses compressed
+        # signatures on host (cheap flag/range checks) and runs the
+        # field work (sqrt, sign, on-curve, psi subgroup) batched inside
+        # the flush program via the plane's *_parsed API; "python" keeps
+        # the host bigint decode; "auto" resolves to device only on a
+        # TPU backend with a parsed-capable plane. python is ALSO the
+        # degradation rung below device (PR 2 ladder): a device failure
+        # in a parsed flush steps this coalescer down permanently.
+        if decode_mode not in ("auto", "device", "python"):
+            raise ValueError(f"bad decode_mode {decode_mode!r}")
+        self.decode_mode = decode_mode
+        self._decode_live: str | None = None  # resolved lazily
         # msm-off degradation rung (mirrors tbls/tpu_impl._rlc_guarded):
         # a device/compile failure in the newest kernel family is not a
         # crypto verdict. plane_factory() rebuilds the plane after the
@@ -253,6 +322,41 @@ class SlotCoalescer:
     @property
     def t(self) -> int:
         return self.plane.t
+
+    # -- decode-mode resolution (ISSUE 5) ----------------------------------
+
+    def _plane_has_parsed_api(self) -> bool:
+        return self._plane_has_packed_api() and all(
+            hasattr(self.plane, name)
+            for name in (
+                "pack_verify_inputs_parsed",
+                "verify_packed_parsed",
+                "pack_inputs_parsed",
+                "recombine_packed_parsed",
+            )
+        )
+
+    def _decode_rung(self) -> str:
+        """The decode rung in force: 'device' ships parsed signature
+        lanes to decode-fused programs, 'python' decompresses on host.
+        Resolved once, lazily: 'auto' means device only on a TPU backend
+        (CPU sqrt chains are slower than the host bigints they replace)
+        AND a parsed-capable plane; a forced 'device' still needs the
+        plane API (test fakes without it stay on python). A device
+        failure in a parsed flush steps the live rung down to python
+        permanently (PR 2 ladder)."""
+        if self._decode_live is None:
+            mode = self.decode_mode
+            if _dec is None or not self._plane_has_parsed_api():
+                mode = "python"
+            elif mode == "auto":
+                # the parsed API implies a real jax plane, so this
+                # import resolves to the already-loaded module
+                from charon_tpu.ops import limb
+
+                mode = "device" if limb._is_tpu_backend() else "python"
+            self._decode_live = mode
+        return self._decode_live
 
     @property
     def current_window(self) -> float:
@@ -349,8 +453,13 @@ class SlotCoalescer:
         ticket = loop.create_future()
         self._decode_tickets.add(ticket)
         try:
+            decode_fn = (
+                _parse_verify_lane
+                if self._decode_rung() == "device"
+                else _decode_verify_lane
+            )
             lanes, delays, spans = await self._map_offloop(
-                _decode_verify_lane, list(items)
+                decode_fn, list(items)
             )
             job = _VerifyJob(
                 lanes=lanes,
@@ -384,6 +493,13 @@ class SlotCoalescer:
         if not roots:
             return [], []
         t = self.plane.t
+        device_decode = self._decode_rung() == "device"
+
+        def parse_partial(sig: bytes):
+            parsed = _dec.parse_g2_lane(sig)
+            if not parsed.ok or parsed.infinity:
+                raise TblsError("malformed partial signature")
+            return parsed
 
         def decode_row(row):
             ps_row, root, sig_row, gpk, idx_row = row
@@ -395,7 +511,13 @@ class SlotCoalescer:
                 return (
                     [_decode_pubkey(p) for p in ps_row],
                     _msg_point(root),
-                    [_decode_sig(s) for s in sig_row],
+                    # device rung: partials ship as PARSED lanes (no
+                    # field arithmetic here) — the flush program
+                    # decompresses them; host-parse rejects prefail
+                    [
+                        parse_partial(s) if device_decode else _decode_sig(s)
+                        for s in sig_row
+                    ],
                     _decode_pubkey(gpk),
                     list(idx_row),
                     False,
@@ -554,9 +676,18 @@ class SlotCoalescer:
                     inflight,
                 )
             except Exception as e:  # noqa: BLE001 — degrade or fail waiters
-                retried = await self._degrade_and_retry(
+                # first rung below the device decode: step decode down
+                # to python for good and retry the SAME batch — the
+                # decode-fused programs are the newest kernel family, so
+                # a failure there must not cost the older point-input
+                # path (or burn the process-wide msm-off rung)
+                retried = await self._decode_stepdown_and_retry(
                     vq, rq, e, window_used, inflight
                 )
+                if retried is None:
+                    retried = await self._degrade_and_retry(
+                        vq, rq, e, window_used, inflight
+                    )
                 if retried is None:
                     # last rung: the pure-python spec oracle. Orders of
                     # magnitude slower than the device, but a wedged
@@ -629,6 +760,48 @@ class SlotCoalescer:
     def _flat_verify_lanes(vq: list[_VerifyJob]) -> list:
         return [lane for job in vq for lane in job.lanes if lane is not None]
 
+    def _normalize_jobs(self, vq, rq) -> bool:
+        """One flush, one lane representation (worker thread). Returns
+        True when the flush ships PARSED signature lanes to the
+        decode-fused device programs. That needs the device rung still
+        live AND every lane parsed — a rung step-down between
+        submissions can leave a window holding both kinds, and the
+        retry of a failed parsed flush arrives here after the step-down;
+        in either case the parsed lanes convert to points on host (the
+        python rung), flipping a job's prefail slot when a partial
+        fails host decompression. Idempotent, cheap when nothing is
+        parsed."""
+        kinds = set()
+        for job in vq:
+            for lane in job.lanes:
+                if lane is not None:
+                    kinds.add(isinstance(lane[2], _PARSED_T))
+        for job in rq:
+            for i, pf in enumerate(job.prefail):
+                if not pf:
+                    kinds.add(
+                        isinstance(job.partials[i][0], _PARSED_T)
+                    )
+        if True not in kinds:
+            return False
+        if kinds == {True} and self._decode_rung() == "device":
+            return True
+        for job in vq:
+            job.lanes = [_lane_to_points(lane) for lane in job.lanes]
+        for job in rq:
+            for i in range(len(job.msgs)):
+                if job.prefail[i] or not isinstance(
+                    job.partials[i][0], _PARSED_T
+                ):
+                    continue
+                try:
+                    job.partials[i] = [
+                        _decode_sig(p.raw) for p in job.partials[i]
+                    ]
+                except (TblsError, ValueError):
+                    job.prefail[i] = True
+        return False
+
     @staticmethod
     def _live_recombine_rows(rq: list[_RecombineJob]):
         ps, msg, sig, gpk, idx = [], [], [], [], []
@@ -649,22 +822,31 @@ class SlotCoalescer:
         recombine_host work that does NOT need the device lane."""
         w0 = time.time()
         plane = self.plane
+        parsed = self._normalize_jobs(vq, rq)
         vpack = None
         flat = self._flat_verify_lanes(vq)
         if flat:
             pks, msgs, sigs = zip(*flat)
+            pack = (
+                plane.pack_verify_inputs_parsed
+                if parsed
+                else plane.pack_verify_inputs
+            )
             vpack = (
-                plane.pack_verify_inputs(pks, msgs, sigs),
+                pack(pks, msgs, sigs),
                 plane.make_lane_rand(len(flat)),
                 len(flat),
+                parsed,
             )
         rpack = None
         ps, msg, sig, gpk, idx = self._live_recombine_rows(rq)
         if msg:
+            pack = plane.pack_inputs_parsed if parsed else plane.pack_inputs
             rpack = (
-                plane.pack_inputs(ps, msg, sig, gpk, idx),
+                pack(ps, msg, sig, gpk, idx),
                 plane.make_rand(len(msg)),
                 len(msg),
+                parsed,
             )
         return vpack, rpack, (w0, time.time())
 
@@ -685,6 +867,10 @@ class SlotCoalescer:
         vpack, rpack, pack_span = (
             packed if packed is not None else (None, None, None)
         )
+        if packed is None:
+            # single-stage flush (pool disabled / pack failed): lane
+            # normalization runs here on the device lane instead
+            parsed = self._normalize_jobs(vq, rq)
         lanes = 0
         pad_lanes = padded_lanes = 0 if packed is not None else None
         vres: list[list[bool]] = []
@@ -692,15 +878,30 @@ class SlotCoalescer:
             if vpack is not None:
                 # flat lane count came with the pack — don't re-flatten
                 # on the serialized device lane
-                arrays, rand, n = vpack
-                oks = iter(self.plane.verify_packed(arrays, rand, n))
+                arrays, rand, n, vparsed = vpack
+                verify = (
+                    self.plane.verify_packed_parsed
+                    if vparsed
+                    else self.plane.verify_packed
+                )
+                oks = iter(verify(arrays, rand, n))
                 shipped = self._packed_lane_count(arrays)
                 pad_lanes += shipped - n
                 padded_lanes += shipped
             else:
                 flat = self._flat_verify_lanes(vq)
                 n = len(flat)
-                if flat:
+                if flat and parsed:
+                    pks, msgs, sigs = zip(*flat)
+                    arrays = self.plane.pack_verify_inputs_parsed(
+                        pks, msgs, sigs
+                    )
+                    oks = iter(
+                        self.plane.verify_packed_parsed(
+                            arrays, self.plane.make_lane_rand(n), n
+                        )
+                    )
+                elif flat:
                     pks, msgs, sigs = zip(*flat)
                     oks = iter(self.plane.verify_host(pks, msgs, sigs))
                 else:
@@ -716,16 +917,26 @@ class SlotCoalescer:
         rres: list[tuple[list, list[bool]]] = []
         if rq:
             if rpack is not None:
-                arrays, rand, v = rpack
-                out_sigs, out_oks = self.plane.recombine_packed(
-                    arrays, rand, v
+                arrays, rand, v, rparsed = rpack
+                recombine = (
+                    self.plane.recombine_packed_parsed
+                    if rparsed
+                    else self.plane.recombine_packed
                 )
+                out_sigs, out_oks = recombine(arrays, rand, v)
                 shipped = self._packed_lane_count(arrays)
                 pad_lanes += shipped - v
                 padded_lanes += shipped
             else:
                 ps, msg, sig, gpk, idx = self._live_recombine_rows(rq)
-                if msg:
+                if msg and parsed:
+                    args = self.plane.pack_inputs_parsed(
+                        ps, msg, sig, gpk, idx
+                    )
+                    out_sigs, out_oks = self.plane.recombine_packed_parsed(
+                        args, self.plane.make_rand(len(msg)), len(msg)
+                    )
+                elif msg:
                     out_sigs, out_oks = self.plane.recombine_host(
                         ps, msg, sig, gpk, idx
                     )
@@ -746,6 +957,7 @@ class SlotCoalescer:
                         live_rows += 1
                 rres.append((sigs_pts, oks))
             lanes += live_rows
+        mode, cache_n, device_n, python_n = self._decode_breakdown(vq, rq)
         self._account_flush(
             vq,
             rq,
@@ -759,6 +971,10 @@ class SlotCoalescer:
                 pad_lanes=pad_lanes,
                 padded_lanes=padded_lanes,
                 decode_queue_seconds=self._job_decode_delays(vq, rq),
+                decode_mode=mode,
+                decode_cache_lanes=cache_n,
+                decode_device_lanes=device_n,
+                decode_python_lanes=python_n,
                 decode_spans=self._job_decode_spans(vq, rq),
                 pack_span=pack_span,
                 device_span=(w0, time.time()),
@@ -788,6 +1004,43 @@ class SlotCoalescer:
             span for job in [*vq, *rq] for span in job.decode_spans
         )
 
+    def _decode_breakdown(self, vq, rq) -> tuple[str, int, int, int]:
+        """(mode, cache_lanes, device_lanes, python_lanes) of a flush:
+        cache_lanes counts point lookups served by the tpu_impl LRU
+        caches (pubkey + message per verify lane; pubshares + message +
+        group pubkey per recombine row), device/python_lanes count
+        signature lanes by decode rung. The mode is what actually
+        shipped; a flush with NO live signature lanes (every lane
+        prefailed on host parse) reports the rung in force instead, so
+        the tpu_plane_decode_mode gauge never fakes a ladder step-down
+        off a fully-malformed window."""
+        cache = device = python = 0
+        for job in vq:
+            for lane in job.lanes:
+                if lane is None:
+                    continue
+                cache += 2
+                if isinstance(lane[2], _PARSED_T):
+                    device += 1
+                else:
+                    python += 1
+        for job in rq:
+            for i, pf in enumerate(job.prefail):
+                if pf:
+                    continue
+                cache += len(job.pubshares[i]) + 2
+                if isinstance(job.partials[i][0], _PARSED_T):
+                    device += len(job.partials[i])
+                else:
+                    python += len(job.partials[i])
+        if device:
+            mode = "device"
+        elif python:
+            mode = "python"
+        else:
+            mode = self._decode_live or "python"
+        return mode, cache, device, python
+
     @staticmethod
     def _job_parents(vq, rq) -> tuple:
         """Submitting-span contexts of this flush's jobs (deduped by
@@ -807,6 +1060,62 @@ class SlotCoalescer:
             self.metrics_hook(len(vq) + len(rq), lanes)
         if self.stats_hook is not None:
             self.stats_hook(stats)
+
+    async def _decode_stepdown_and_retry(
+        self, vq, rq, err, window_used: float = 0.0, inflight: int = 1
+    ):
+        """Decode-ladder rung (ISSUE 5): a failed flush that shipped
+        PARSED lanes steps this coalescer's decode rung down to python
+        permanently, converts the batch's parsed signatures to points
+        on host, and retries the same batch through the point-input
+        programs. Returns (vres, rres) or None when inapplicable (the
+        flush wasn't parsed) or the retry itself failed — the caller
+        continues down the existing msm-off / host-oracle ladder.
+
+        Applicability is judged by the BATCH (did parsed lanes ship?),
+        not by the current rung: with double-buffered windows a second
+        in-flight parsed flush can fail AFTER the first one already
+        stepped the rung down, and it must still retry here instead of
+        burning the process-wide msm-off rung on a decode-family
+        failure."""
+        if self._closed:
+            return None
+        parsed = any(
+            lane is not None and isinstance(lane[2], _PARSED_T)
+            for job in vq
+            for lane in job.lanes
+        ) or any(
+            not pf and isinstance(job.partials[i][0], _PARSED_T)
+            for job in rq
+            for i, pf in enumerate(job.prefail)
+        )
+        if not parsed:
+            return None
+        from charon_tpu.app import log
+
+        log.warn(
+            "crypto plane parsed flush failed on device; decode "
+            + (
+                "stepping down to python"
+                if self._decode_live == "device"
+                else "rung already stepped down; retrying on python"
+            ),
+            topic="cryptoplane",
+            rung="decode-python",
+            err=f"{type(err).__name__}: {str(err)[:160]}",
+        )
+        self._decode_live = "python"
+
+        def convert_and_run():
+            # worker thread: _normalize_jobs sees the stepped-down rung
+            # and host-decodes every parsed lane before the device pass
+            return self._run_device(vq, rq, None, window_used, inflight)
+
+        try:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(self._executor, convert_and_run)
+        except Exception:  # noqa: BLE001 — continue down the ladder
+            return None
 
     async def _degrade_and_retry(
         self, vq, rq, err, window_used: float = 0.0, inflight: int = 1
@@ -882,6 +1191,14 @@ class SlotCoalescer:
         fn = getattr(self.plane, "prewarm", None)
         if fn is None:
             return []
+        kwargs = {}
+        if self._decode_rung() == "device":
+            # also compile the decode-fused program family — live
+            # flushes on the device rung land on those shapes
+            import inspect
+
+            if "decompress" in inspect.signature(fn).parameters:
+                kwargs["decompress"] = True
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._executor,
@@ -894,6 +1211,7 @@ class SlotCoalescer:
                     if recombine_lanes is None
                     else tuple(recombine_lanes)
                 ),
+                **kwargs,
             ),
         )
 
@@ -920,6 +1238,11 @@ class SlotCoalescer:
 
         t0 = time.monotonic()
         w0 = time.time()
+        # a parsed flush can land here when every device rung failed:
+        # force the python lane representation first (worker thread —
+        # the bigint decompression belongs here, not the event loop)
+        self._decode_live = "python"
+        self._normalize_jobs(vq, rq)
         lanes = 0
         vres: list[list[bool]] = []
         for job in vq:
@@ -950,6 +1273,7 @@ class SlotCoalescer:
                 oks.append(ok)
                 lanes += 1
             rres.append((sigs_pts, oks))
+        mode, cache_n, device_n, python_n = self._decode_breakdown(vq, rq)
         self._account_flush(
             vq,
             rq,
@@ -964,6 +1288,10 @@ class SlotCoalescer:
                 padded_lanes=None,
                 decode_queue_seconds=self._job_decode_delays(vq, rq),
                 fallback=True,
+                decode_mode=mode,
+                decode_cache_lanes=cache_n,
+                decode_device_lanes=device_n,
+                decode_python_lanes=python_n,
                 decode_spans=self._job_decode_spans(vq, rq),
                 device_span=(w0, time.time()),
                 parents=self._job_parents(vq, rq),

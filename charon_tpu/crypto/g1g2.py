@@ -19,12 +19,16 @@ from charon_tpu.crypto.fields import (
     FP2_ZERO,
     P,
     R,
+    X_ABS,
+    XI,
     fp2_add,
+    fp2_conj,
     fp2_inv,
     fp2_is_lex_largest,
     fp2_is_zero,
     fp2_mul,
     fp2_neg,
+    fp2_pow,
     fp2_scalar,
     fp2_sqr,
     fp2_sqrt,
@@ -160,6 +164,33 @@ def g2_mul(pt, k: int):
 
 def g2_in_subgroup(pt) -> bool:
     return g2_is_on_curve(pt) and g2_mul_raw(pt, R) is None
+
+
+# psi = twist o Frobenius o untwist on the M-twist: the host oracle for
+# the device decompression kernel's fast subgroup check. On G2, psi acts
+# as multiplication by the BLS parameter x = -X_ABS mod r. These
+# constants are THE definition — charon_tpu/ops/decompress.py imports
+# them, so kernel and oracle can never drift apart.
+PSI_CX = fp2_inv(fp2_pow(XI, (P - 1) // 3))
+PSI_CY = fp2_inv(fp2_pow(XI, (P - 1) // 2))
+
+
+def g2_psi(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (fp2_mul(fp2_conj(x), PSI_CX), fp2_mul(fp2_conj(y), PSI_CY))
+
+
+def g2_in_subgroup_psi(pt) -> bool:
+    """Subgroup test via psi(P) == [x]P (Scott 2021) — equivalent to
+    g2_in_subgroup for on-curve points, with a 64-bit ladder instead of
+    the 255-bit [r]P one. Cross-checked in tests/test_decompress.py."""
+    if pt is None:
+        return True
+    return g2_is_on_curve(pt) and g2_psi(pt) == g2_neg(
+        g2_mul_raw(pt, X_ABS)
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from charon_tpu.ops import curve as C
+from charon_tpu.ops import decompress as DEC
 from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
@@ -245,6 +246,27 @@ def _subgroup_g1_kernel(ctx: ModCtx, fr_ctx: ModCtx):
         return C.point_is_identity(f, rp)
 
     return _jit_kernel(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _decompress_g2_kernel(ctx: ModCtx, fr_ctx: ModCtx, subgroup: bool):
+    """Compressed-G2 field work + (optionally) the psi subgroup check in
+    ONE program — the decode stage of a flush no longer pays a separate
+    subgroup_check_g2_batch dispatch (ISSUE 5)."""
+    return _jit_kernel(
+        lambda x0, x1, sign, inf, ok: DEC.decompress_g2_graph(
+            ctx, fr_ctx, (x0, x1), sign, inf, ok, subgroup=subgroup
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _decompress_g1_kernel(ctx: ModCtx, fr_ctx: ModCtx, subgroup: bool):
+    return _jit_kernel(
+        lambda x0, sign, inf, ok: DEC.decompress_g1_graph(
+            ctx, fr_ctx, x0, sign, inf, ok, subgroup=subgroup
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -481,6 +503,51 @@ class BlsEngine:
         )
         mask = _subgroup_g1_kernel(self.ctx, self.fr_ctx)(pts, order)
         return [bool(b) for b in np.asarray(mask)[:n]]
+
+    # -- batched point decompression -------------------------------------
+
+    def decompress_g2_batch(self, encoded, subgroup_check: bool = True):
+        """Compressed 96-byte G2 lanes -> ([affine point | None],
+        [valid]) with the field work (sqrt, sign, on-curve, psi subgroup
+        check) batched on device. Accepts raw bytes or pre-parsed
+        decompress.ParsedPoint lanes. Per-lane semantics, never raises:
+        valid=True with point=None is a well-formed infinity; valid=False
+        covers malformed flags, x >= p, non-residue x and (when
+        `subgroup_check`) non-subgroup points."""
+        parsed = [
+            p if isinstance(p, DEC.ParsedPoint) else DEC.parse_g2_lane(p)
+            for p in encoded
+        ]
+        n = len(parsed)
+        if n == 0:
+            return [], []
+        pad = bucket_lanes(n)
+        parsed = parsed + [parsed[0]] * (pad - n)
+        arrays = DEC.pack_parsed_g2(self.ctx, parsed)
+        aff, valid = _decompress_g2_kernel(
+            self.ctx, self.fr_ctx, subgroup_check
+        )(*arrays)
+        pts = C.g2_unpack(self.ctx, aff)[:n]
+        return pts, [bool(b) for b in np.asarray(valid)[:n]]
+
+    def decompress_g1_batch(self, encoded, subgroup_check: bool = True):
+        """Compressed 48-byte G1 lanes -> ([affine point | None],
+        [valid]); see decompress_g2_batch for the mask contract."""
+        parsed = [
+            p if isinstance(p, DEC.ParsedPoint) else DEC.parse_g1_lane(p)
+            for p in encoded
+        ]
+        n = len(parsed)
+        if n == 0:
+            return [], []
+        pad = bucket_lanes(n)
+        parsed = parsed + [parsed[0]] * (pad - n)
+        arrays = DEC.pack_parsed_g1(self.ctx, parsed)
+        aff, valid = _decompress_g1_kernel(
+            self.ctx, self.fr_ctx, subgroup_check
+        )(*arrays)
+        pts = C.g1_unpack(self.ctx, aff)[:n]
+        return pts, [bool(b) for b in np.asarray(valid)[:n]]
 
     # -- scalar multiplication (DKG / key derivation) --------------------
 

@@ -1,0 +1,375 @@
+"""Batched G1/G2 point decompression on the limb engine (ISSUE 5).
+
+The last pure-Python hot loop on the duty path was compressed-point
+decode: `g1g2.g2_from_bytes` runs an Fp2 square root with Python bigints
+(~ms per signature), and — with pubkeys and messages LRU-cached — the
+always-fresh SIGNATURE decompression dominated the host cost of every
+coalescer flush. This module splits decode the same way the rest of the
+engine splits work (SURVEY §7):
+
+  * HOST — `parse_g2_lane`/`parse_g1_lane`: flag-bit validation, infinity
+    encoding checks, x < p range checks, bytes -> ints. Microseconds per
+    lane, no field arithmetic, no jax import (bench_hostplane measures
+    this side without a device).
+  * DEVICE — `decompress_g2_graph`/`decompress_g1_graph`: the field work,
+    batched over lanes inside whatever jitted program the caller builds
+    (blsops kernels, the mesh plane's fused decode+verify programs):
+      - y^2 = x^3 + b, then the square root by a FIXED-exponent chain:
+        p^2 = 9 mod 16, so the candidate a^((p^2+7)/16) is off from a
+        true root by one of the four 4th roots of unity; four cheap
+        multiply+compare corrections recover the root or prove a is a
+        non-residue (the on-curve check y^2 == x^3 + b and sqrt
+        verification are the same comparison). G1 uses p = 3 mod 4 and
+        a^((p+1)/4).
+      - ZCash sign-bit selection (lexicographically-largest y).
+      - G2 subgroup membership by the psi endomorphism: P is in G2 iff
+        psi(P) == [x]P with x the (negative) BLS parameter — a 64-bit
+        ladder instead of the 255-bit [r]P ladder (Scott 2021, "A note
+        on group membership tests"; host oracle: g1g2.g2_psi). G1 keeps
+        the [r]P ladder (the pubkey path is cache-hit dominated).
+
+    Malformed encodings NEVER raise: every lane carries a validity bit
+    from host parse through the device mask, so one forged signature in
+    a flush fails per-lane instead of exploding the batch.
+
+Host constants below are computed with charon_tpu/crypto/fields (pure
+ints) so importing this module never touches jax — the graph functions
+import the limb engine lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from charon_tpu.crypto import fields as F
+
+P = F.P
+
+_COMPRESSED = 0x80
+_INFINITY = 0x40
+_LEX_LARGEST = 0x20
+
+# -- fixed-exponent sqrt chains ---------------------------------------------
+# p^2 = 9 mod 16: candidate c = a^((p^2+7)/16) satisfies c^2 = a * eta with
+# eta^4 == 1; the correction factors r (r^2 = eta^-1) are the four values
+# below. p = 3 mod 4 for the G1 chain.
+SQRT_EXP_G2 = (P * P + 7) // 16
+SQRT_EXP_G1 = (P + 1) // 4
+_S1 = F.fp2_sqrt((P - 1, 0))  # sqrt(-1)
+ROOTS_OF_UNITY = (
+    F.FP2_ONE,
+    _S1,
+    F.fp2_sqrt(_S1),
+    F.fp2_sqrt(F.fp2_neg(_S1)),
+)
+ROOTS_OF_UNITY_SQ = tuple(F.fp2_sqr(r) for r in ROOTS_OF_UNITY)
+
+# -- psi endomorphism (untwist-Frobenius-twist) on the M-twist --------------
+# psi(x, y) = (cx * conj(x), cy * conj(y)); on G2 psi acts as
+# multiplication by the BLS parameter x = -X_ABS (mod r). The constants
+# are imported from the host oracle (g1g2.g2_psi, jax-free) — one
+# definition, so kernel and oracle cannot drift.
+from charon_tpu.crypto.g1g2 import PSI_CX, PSI_CY  # noqa: E402
+
+X_ABS = F.X_ABS
+
+_HALF = (P - 1) // 2  # lex-largest threshold
+
+
+# ---------------------------------------------------------------------------
+# Host parse (jax-free: bench_hostplane times this side standalone)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ParsedPoint:
+    """One compressed lane after host parse. `ok` is the HOST verdict
+    (flags / range / length); the device adds residue + subgroup bits.
+    `raw` keeps the wire bytes so degradation rungs below the device
+    (python decode) can re-serve the lane without replumbing."""
+
+    raw: bytes
+    x0: int  # real Fp component (the only one for G1)
+    x1: int
+    sign: bool  # lexicographically-largest-y flag
+    infinity: bool
+    ok: bool
+
+
+def parse_g2_lane(data: bytes) -> ParsedPoint:
+    """96-byte compressed G2 -> ParsedPoint. Never raises."""
+    sign = infinity = False
+    x0 = x1 = 0
+    ok = len(data) == 96 and bool(data[0] & _COMPRESSED)
+    if ok:
+        flags = data[0]
+        infinity = bool(flags & _INFINITY)
+        sign = bool(flags & _LEX_LARGEST)
+        if infinity:
+            # spec: infinity is the flag byte alone, zero elsewhere
+            ok = not (flags & 0x3F) and not any(data[1:])
+            sign = False
+        else:
+            x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+            x0 = int.from_bytes(data[48:], "big")
+            if x0 >= P or x1 >= P:
+                ok = False
+    if not ok:
+        x0 = x1 = 0  # never ship unreduced limbs to the device
+        sign = infinity = False
+    return ParsedPoint(bytes(data), x0, x1, sign, infinity, ok)
+
+
+def parse_g1_lane(data: bytes) -> ParsedPoint:
+    """48-byte compressed G1 -> ParsedPoint (x1 unused)."""
+    sign = infinity = False
+    x0 = 0
+    ok = len(data) == 48 and bool(data[0] & _COMPRESSED)
+    if ok:
+        flags = data[0]
+        infinity = bool(flags & _INFINITY)
+        sign = bool(flags & _LEX_LARGEST)
+        if infinity:
+            ok = not (flags & 0x3F) and not any(data[1:])
+            sign = False
+        else:
+            x0 = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+            if x0 >= P:
+                ok = False
+    if not ok:
+        x0 = 0
+        sign = infinity = False
+    return ParsedPoint(bytes(data), x0, 0, sign, infinity, ok)
+
+
+def pack_parsed_g2(ctx, parsed):
+    """[ParsedPoint] -> device inputs (x0, x1 raw limbs, sign, infinity,
+    host_ok masks). Numpy/jnp packing only — the cheap half of decode."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from charon_tpu.ops import limb
+
+    x0 = jnp.asarray(limb.ctx_pack(ctx, [p.x0 for p in parsed]))
+    x1 = jnp.asarray(limb.ctx_pack(ctx, [p.x1 for p in parsed]))
+    sign = jnp.asarray(np.asarray([p.sign for p in parsed], bool))
+    inf = jnp.asarray(np.asarray([p.infinity for p in parsed], bool))
+    ok = jnp.asarray(np.asarray([p.ok for p in parsed], bool))
+    return x0, x1, sign, inf, ok
+
+
+def pack_parsed_g1(ctx, parsed):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from charon_tpu.ops import limb
+
+    x0 = jnp.asarray(limb.ctx_pack(ctx, [p.x0 for p in parsed]))
+    sign = jnp.asarray(np.asarray([p.sign for p in parsed], bool))
+    inf = jnp.asarray(np.asarray([p.infinity for p in parsed], bool))
+    ok = jnp.asarray(np.asarray([p.ok for p in parsed], bool))
+    return x0, sign, inf, ok
+
+
+# ---------------------------------------------------------------------------
+# Device graph pieces (composable inside any jitted program)
+# ---------------------------------------------------------------------------
+
+
+def fp2_pow_const(ctx, a, exponent: int):
+    """a^exponent in Fp2 (Montgomery in/out), square-and-multiply as a
+    lax.scan over the STATIC exponent bits — the Fp2 twin of
+    limb.mont_pow, used for the fixed sqrt chains."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from charon_tpu.ops import fptower as T
+    from charon_tpu.ops import limb
+
+    if exponent == 0:
+        return T.fp2_one(ctx, a[0].shape[:-1])
+    bits = jnp.asarray(limb._exp_bits(exponent))
+
+    def step(acc, bit):
+        acc = T.fp2_sqr(ctx, acc)
+        mul = T.fp2_mul(ctx, acc, a)
+        out = (
+            jnp.where(bit != 0, mul[0], acc[0]),
+            jnp.where(bit != 0, mul[1], acc[1]),
+        )
+        return out, None
+
+    acc, _ = lax.scan(step, a, bits[1:])  # leading 1 bit: start from a
+    return acc
+
+
+def _raw_gt_const(ctx, raw, const_limbs):
+    """Per-lane raw-limb comparison raw > const (little-endian limbs):
+    most-significant differing limb decides."""
+    import jax.numpy as jnp
+
+    c = jnp.asarray(const_limbs)
+    gt = jnp.flip(raw > c, axis=-1)  # most significant first
+    eq = jnp.flip(raw == c, axis=-1)
+    # exclusive prefix-AND of eq: limb i decides only if all above agree
+    pre = jnp.cumprod(
+        jnp.concatenate(
+            [jnp.ones_like(eq[..., :1]), eq[..., :-1]], axis=-1
+        ),
+        axis=-1,
+    ).astype(bool)
+    return jnp.any(gt & pre, axis=-1)
+
+
+def _half_limbs(ctx):
+    from charon_tpu.ops import limb
+
+    return limb.int_to_limbs(_HALF, ctx.n_limbs, ctx.limb_bits, ctx.np_dtype)
+
+
+def fp2_is_lex_largest_graph(ctx, y):
+    """Device mirror of fields.fp2_is_lex_largest on a Montgomery Fp2
+    element: compare (c1, c0) lexicographically against -y."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import limb
+
+    y0r = limb.from_mont(ctx, y[0])
+    y1r = limb.from_mont(ctx, y[1])
+    half = _half_limbs(ctx)
+    return jnp.where(
+        limb.is_zero(y1r),
+        _raw_gt_const(ctx, y0r, half),
+        _raw_gt_const(ctx, y1r, half),
+    )
+
+
+def g2_psi_graph(ctx, affine):
+    """psi(x, y) = (cx * conj(x), cy * conj(y)) on batched affine G2."""
+    from charon_tpu.ops import fptower as T
+
+    x, y = affine
+    shape = x[0].shape[:-1]
+    cx = T.fp2_const(ctx, PSI_CX, shape)
+    cy = T.fp2_const(ctx, PSI_CY, shape)
+    return (
+        T.fp2_mul(ctx, T.fp2_conj(ctx, x), cx),
+        T.fp2_mul(ctx, T.fp2_conj(ctx, y), cy),
+    )
+
+
+def g2_subgroup_psi_graph(ctx, fr_ctx, affine):
+    """P in G2 iff psi(P) == [x]P, i.e. psi(P) + [|x|]P == identity (x is
+    negative for BLS12-381). One 64-bit ladder — ~4x less point work than
+    the [r]P check. Identity lanes ((0,0) affine) pass."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as C
+    from charon_tpu.ops import limb
+
+    f = C.g2_ops(ctx)
+    proj = C.affine_to_point(f, affine)
+    scal = jnp.asarray(
+        limb.int_to_limbs(
+            X_ABS, fr_ctx.n_limbs, fr_ctx.limb_bits, fr_ctx.np_dtype
+        )
+    )
+    xp = C.point_scalar_mul(f, fr_ctx, proj, scal, nbits=X_ABS.bit_length())
+    psi = C.affine_to_point(f, g2_psi_graph(ctx, affine))
+    return C.point_is_identity(f, C.point_add(f, xp, psi))
+
+
+def decompress_g2_graph(
+    ctx, fr_ctx, x_raw, sign, infinity=None, host_ok=None, subgroup=True
+):
+    """Batched compressed-G2 field work: raw x limbs (pair of (..., L)
+    arrays) + host parse masks -> ((x, y) Montgomery affine, valid).
+
+    valid lanes: finite on-curve (subgroup-checked when `subgroup`)
+    points, plus well-formed infinity lanes; both infinity and invalid
+    lanes come out as the (0, 0) affine identity encoding."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import fptower as T
+    from charon_tpu.ops import limb
+
+    shape = x_raw[0].shape[:-1]
+    if infinity is None:
+        infinity = jnp.zeros(shape, bool)
+    if host_ok is None:
+        host_ok = jnp.ones(shape, bool)
+    x = (limb.to_mont(ctx, x_raw[0]), limb.to_mont(ctx, x_raw[1]))
+    b = T.fp2_const(ctx, (4, 4), shape)  # 4(1 + u)
+    a = T.fp2_add(ctx, T.fp2_mul(ctx, T.fp2_sqr(ctx, x), x), b)
+    c = fp2_pow_const(ctx, a, SQRT_EXP_G2)
+    c2 = T.fp2_sqr(ctx, c)
+    y = T.fp2_zero(ctx, shape)
+    ok_sqrt = jnp.zeros(shape, bool)
+    # four-root correction; the match test doubles as the on-curve check
+    for r, r2 in zip(ROOTS_OF_UNITY, ROOTS_OF_UNITY_SQ):
+        match = T.fp2_eq(
+            T.fp2_mul(ctx, c2, T.fp2_const(ctx, r2, shape)), a
+        )
+        cand = T.fp2_mul(ctx, c, T.fp2_const(ctx, r, shape))
+        y = T.fp2_select(match & ~ok_sqrt, cand, y)
+        ok_sqrt = ok_sqrt | match
+    largest = fp2_is_lex_largest_graph(ctx, y)
+    y = T.fp2_select(largest != sign, T.fp2_neg(ctx, y), y)
+    valid = ok_sqrt & host_ok & ~infinity
+    # blank non-valid lanes to the identity encoding BEFORE the subgroup
+    # ladder so garbage x never feeds the point formulas
+    zero = T.fp2_zero(ctx, shape)
+    x = T.fp2_select(valid, x, zero)
+    y = T.fp2_select(valid, y, zero)
+    if subgroup:
+        valid = valid & g2_subgroup_psi_graph(ctx, fr_ctx, (x, y))
+        x = T.fp2_select(valid, x, zero)
+        y = T.fp2_select(valid, y, zero)
+    return (x, y), valid | (infinity & host_ok)
+
+
+def decompress_g1_graph(
+    ctx, fr_ctx, x_raw, sign, infinity=None, host_ok=None, subgroup=True
+):
+    """Batched compressed-G1 field work (Fp chain, p = 3 mod 4). The
+    subgroup check keeps the [r]P ladder — the pubkey path is cache-hit
+    dominated, so simplicity beats the GLV shortcut here."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as C
+    from charon_tpu.ops import limb
+
+    shape = x_raw.shape[:-1]
+    if infinity is None:
+        infinity = jnp.zeros(shape, bool)
+    if host_ok is None:
+        host_ok = jnp.ones(shape, bool)
+    x = limb.to_mont(ctx, x_raw)
+    b = limb.const(ctx, 4, shape)
+    a = limb.add_mod(
+        ctx, limb.mont_mul(ctx, limb.mont_sqr(ctx, x), x), b
+    )
+    y = limb.mont_pow(ctx, a, SQRT_EXP_G1)
+    ok_sqrt = jnp.all(limb.mont_sqr(ctx, y) == a, axis=-1)
+    largest = _raw_gt_const(ctx, limb.from_mont(ctx, y), _half_limbs(ctx))
+    y = limb.select(largest != sign, limb.neg_mod(ctx, y), y)
+    valid = ok_sqrt & host_ok & ~infinity
+    zero = limb.zeros(ctx, shape)
+    x = limb.select(valid, x, zero)
+    y = limb.select(valid, y, zero)
+    if subgroup:
+        f = C.g1_ops(ctx)
+        proj = C.affine_to_point(f, (x, y))
+        order = jnp.asarray(
+            limb.int_to_limbs(
+                fr_ctx.modulus,
+                fr_ctx.n_limbs,
+                fr_ctx.limb_bits,
+                fr_ctx.np_dtype,
+            )
+        )
+        rp = C.point_scalar_mul(f, fr_ctx, proj, order)
+        valid = valid & C.point_is_identity(f, rp)
+        x = limb.select(valid, x, zero)
+        y = limb.select(valid, y, zero)
+    return (x, y), valid | (infinity & host_ok)

@@ -29,6 +29,7 @@ except AttributeError:  # older jax: experimental namespace
 
 from charon_tpu.ops import blsops
 from charon_tpu.ops import curve as C
+from charon_tpu.ops import decompress as DEC
 from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
@@ -107,43 +108,82 @@ class SlotCryptoPlane:
         self._step_rlc = self._build_rlc()
         self._verify = self._build_verify()
         self._verify_rlc = self._build_verify_rlc()
+        # decode-fused variants (ISSUE 5): signatures arrive as parsed
+        # compressed lanes and the program decompresses them on device
+        # before verifying — the coalescer's `decode_mode device` path.
+        # Construction is free (jit compiles lazily on first call), so
+        # planes that never see parsed flushes never compile these.
+        self._verify_dec = self._build_verify_dec()
+        self._verify_rlc_dec = self._build_verify_rlc_dec()
+        self._step_dec = self._build_dec()
+        self._step_rlc_dec = self._build_rlc_dec()
+
+    def _step_body(self, pubshares, msg, partials, group_pk, indices, live):
+        """Per-shard recombine + per-lane attribution verify. Shared by
+        the point-input program and the decode-fused one (which ANDs its
+        decompression mask into `live` before calling)."""
+        ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
+        # Threshold recombination first [Vl] — it has no data dependency
+        # on the verifies, and doing it first lets BOTH verify tiers run
+        # as ONE batched pairing program over Vl*(t+1) lanes (a single
+        # Miller-loop/final-exp subgraph in the compiled module instead
+        # of two, which halves the dominant XLA compile cost and keeps
+        # the device busy with one large batch instead of two smaller
+        # ones).
+        group_sig = blsops.threshold_recombine(ctx, fr_ctx, t, partials, indices)
+
+        # Verify lanes: [Vl, t] per-share partials ++ [Vl, 1] group sig,
+        # flattened to one [Vl*(t+1)] batch.
+        cat = lambda a, b: jnp.concatenate(
+            (a, b[:, None, ...]), axis=1
+        ).reshape(-1, *a.shape[2:])
+        pk_all = jax.tree_util.tree_map(cat, pubshares, group_pk)
+        sig_all = jax.tree_util.tree_map(cat, partials, group_sig)
+        msg_rep = jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a, t + 1, axis=0), msg
+        )
+        ok_all = DP.batched_verify(ctx, pk_all, msg_rep, sig_all)
+        ok = jnp.all(ok_all.reshape(-1, t + 1), axis=-1)
+        # `live` masks padding lanes (V rounded up to the mesh size)
+        # out of the cluster-wide count
+        ok = jnp.logical_and(ok, live)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis)
+        return group_sig, ok, total
 
     def _build(self):
-        ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
-        g2f = C.g2_ops(ctx)
+        axis = self.axis
 
-        def local_step(pubshares, msg, partials, group_pk, indices, live):
-            # Threshold recombination first [Vl] — it has no data dependency
-            # on the verifies, and doing it first lets BOTH verify tiers run
-            # as ONE batched pairing program over Vl*(t+1) lanes (a single
-            # Miller-loop/final-exp subgraph in the compiled module instead
-            # of two, which halves the dominant XLA compile cost and keeps
-            # the device busy with one large batch instead of two smaller
-            # ones).
-            group_sig = blsops.threshold_recombine(ctx, fr_ctx, t, partials, indices)
+        sharded = _shard_map(
+            self._step_body,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P()),
+        )
+        return jax.jit(sharded)
 
-            # Verify lanes: [Vl, t] per-share partials ++ [Vl, 1] group sig,
-            # flattened to one [Vl*(t+1)] batch.
-            cat = lambda a, b: jnp.concatenate(
-                (a, b[:, None, ...]), axis=1
-            ).reshape(-1, *a.shape[2:])
-            pk_all = jax.tree_util.tree_map(cat, pubshares, group_pk)
-            sig_all = jax.tree_util.tree_map(cat, partials, group_sig)
-            msg_rep = jax.tree_util.tree_map(
-                lambda a: jnp.repeat(a, t + 1, axis=0), msg
+    def _build_dec(self):
+        """Attribution recombine on PARSED partials: decompress the
+        [Vl, t] signature grid in-program, then the shared step body.
+        Rows with any undecodable partial recombine as identities and
+        fail via the decode mask folded into `live`."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local_step(ps, msg, px0, px1, psign, gpk, idx, live):
+            partials, dec_ok = DEC.decompress_g2_graph(
+                ctx, fr_ctx, (px0, px1), psign
             )
-            ok_all = DP.batched_verify(ctx, pk_all, msg_rep, sig_all)
-            ok = jnp.all(ok_all.reshape(-1, t + 1), axis=-1)
-            # `live` masks padding lanes (V rounded up to the mesh size)
-            # out of the cluster-wide count
-            ok = jnp.logical_and(ok, live)
-            total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axis)
-            return group_sig, ok, total
+            row_ok = jnp.all(dec_ok, axis=1)
+            return self._step_body(
+                ps, msg, partials, gpk, idx, jnp.logical_and(live, row_ok)
+            )
 
         sharded = _shard_map(
             local_step,
             mesh=self.mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                P(axis), P(axis),
+            ),
             out_specs=(P(axis), P(axis), P()),
         )
         return jax.jit(sharded)
@@ -159,81 +199,163 @@ class SlotCryptoPlane:
         slower `step` (the reference pays per-signature herumi calls for
         every duty; here the common all-valid case costs one shared tail
         per shard — core/sigagg/sigagg.go:84-122)."""
-        ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
-        g2f = C.g2_ops(ctx)
-
-        def local_step(pubshares, msg, partials, group_pk, indices, live, rand):
-            group_sig = blsops.threshold_recombine(ctx, fr_ctx, t, partials, indices)
-
-            # INDEPENDENT exponent per verify lane ([Vl, t+1] from the
-            # host): sharing one exponent across a validator's t+1 lanes
-            # would let colluding operators craft partial-sig deltas whose
-            # errors cancel deterministically inside the shared-exponent
-            # product (the group-sig lane error is a public Lagrange
-            # combination of the partial errors). Padding lanes carry
-            # live=False: zero their exponent so their (possibly garbage)
-            # pairing value contributes ^0 = 1.
-            rand_live = jnp.where(live[:, None, None], rand, 0)
-            cat_grid = lambda a, b: jnp.concatenate(
-                (a, b[:, None, ...]), axis=1
-            )
-            pk_grid = jax.tree_util.tree_map(cat_grid, pubshares, group_pk)
-            sig_grid = jax.tree_util.tree_map(cat_grid, partials, group_sig)
-
-            from charon_tpu.ops import msm as MSM
-
-            if MSM.msm_active():
-                # Grouped RLC: a validator's t+1 lanes share its duty
-                # message, so they collapse into ONE bucket pair
-                # e(sum_j r_vj * pk_vj, H_v) — the Miller stage runs
-                # Vl + 1 pairs instead of Vl * (t+1), a (t+1)x cut in
-                # the dominant stage. Straus joint mul batches the
-                # 64-bit randomization over the (Vl, t+1) grid; per-lane
-                # exponents keep the independence property above (same
-                # construction as pairing.batched_verify_grouped_rlc
-                # with per-validator groups).
-                g1f = C.g1_ops(ctx)
-                buckets = MSM.windowed_joint_mul(
-                    g1f,
-                    fr_ctx,
-                    C.affine_to_point(g1f, pk_grid),
-                    rand_live,
-                    nbits=64,
-                )
-                sig_v = MSM.windowed_joint_mul(
-                    g2f,
-                    fr_ctx,
-                    C.affine_to_point(g2f, sig_grid),
-                    rand_live,
-                    nbits=64,
-                )
-                s_total = DP.point_sum_tree(g2f, sig_v, live.shape[0])
-                ok = DP.grouped_rlc_check(ctx, buckets, msg, s_total)
-            else:
-                flat = lambda a: a.reshape(-1, *a.shape[2:])
-                pk_all = jax.tree_util.tree_map(flat, pk_grid)
-                sig_all = jax.tree_util.tree_map(flat, sig_grid)
-                msg_rep = jax.tree_util.tree_map(
-                    lambda a: jnp.repeat(a, t + 1, axis=0), msg
-                )
-                ok = DP.batched_verify_rlc(
-                    ctx,
-                    fr_ctx,
-                    pk_all,
-                    msg_rep,
-                    sig_all,
-                    rand_live.reshape(-1, rand.shape[-1]),
-                )
-            bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
-            return group_sig, bad == 0
+        axis = self.axis
 
         sharded = _shard_map(
-            local_step,
+            self._step_rlc_body,
             mesh=self.mesh,
             in_specs=(
                 P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)
             ),
             out_specs=(P(axis), P()),
+        )
+        return jax.jit(sharded)
+
+    def _step_rlc_body(self, pubshares, msg, partials, group_pk, indices, live, rand):
+        ctx, fr_ctx, t, axis = self.ctx, self.fr_ctx, self.t, self.axis
+        g2f = C.g2_ops(ctx)
+        group_sig = blsops.threshold_recombine(ctx, fr_ctx, t, partials, indices)
+
+        # INDEPENDENT exponent per verify lane ([Vl, t+1] from the
+        # host): sharing one exponent across a validator's t+1 lanes
+        # would let colluding operators craft partial-sig deltas whose
+        # errors cancel deterministically inside the shared-exponent
+        # product (the group-sig lane error is a public Lagrange
+        # combination of the partial errors). Padding lanes carry
+        # live=False: zero their exponent so their (possibly garbage)
+        # pairing value contributes ^0 = 1.
+        rand_live = jnp.where(live[:, None, None], rand, 0)
+        cat_grid = lambda a, b: jnp.concatenate(
+            (a, b[:, None, ...]), axis=1
+        )
+        pk_grid = jax.tree_util.tree_map(cat_grid, pubshares, group_pk)
+        sig_grid = jax.tree_util.tree_map(cat_grid, partials, group_sig)
+
+        from charon_tpu.ops import msm as MSM
+
+        if MSM.msm_active():
+            # Grouped RLC: a validator's t+1 lanes share its duty
+            # message, so they collapse into ONE bucket pair
+            # e(sum_j r_vj * pk_vj, H_v) — the Miller stage runs
+            # Vl + 1 pairs instead of Vl * (t+1), a (t+1)x cut in
+            # the dominant stage. Straus joint mul batches the
+            # 64-bit randomization over the (Vl, t+1) grid; per-lane
+            # exponents keep the independence property above (same
+            # construction as pairing.batched_verify_grouped_rlc
+            # with per-validator groups).
+            g1f = C.g1_ops(ctx)
+            buckets = MSM.windowed_joint_mul(
+                g1f,
+                fr_ctx,
+                C.affine_to_point(g1f, pk_grid),
+                rand_live,
+                nbits=64,
+            )
+            sig_v = MSM.windowed_joint_mul(
+                g2f,
+                fr_ctx,
+                C.affine_to_point(g2f, sig_grid),
+                rand_live,
+                nbits=64,
+            )
+            s_total = DP.point_sum_tree(g2f, sig_v, live.shape[0])
+            ok = DP.grouped_rlc_check(ctx, buckets, msg, s_total)
+        else:
+            flat = lambda a: a.reshape(-1, *a.shape[2:])
+            pk_all = jax.tree_util.tree_map(flat, pk_grid)
+            sig_all = jax.tree_util.tree_map(flat, sig_grid)
+            msg_rep = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, t + 1, axis=0), msg
+            )
+            ok = DP.batched_verify_rlc(
+                ctx,
+                fr_ctx,
+                pk_all,
+                msg_rep,
+                sig_all,
+                rand_live.reshape(-1, rand.shape[-1]),
+            )
+        bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
+        return group_sig, bad == 0
+
+    def _build_rlc_dec(self):
+        """RLC recombine on PARSED partials: in-program decompression,
+        rows with undecodable partials excluded from the shared product
+        (exponent 0) and reported via the third output so the host can
+        attribute per-lane results on the all-valid fast path."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local_step(ps, msg, px0, px1, psign, gpk, idx, live, rand):
+            partials, dec_ok = DEC.decompress_g2_graph(
+                ctx, fr_ctx, (px0, px1), psign
+            )
+            row_ok = jnp.logical_and(jnp.all(dec_ok, axis=1), live)
+            group_sig, all_ok = self._step_rlc_body(
+                ps, msg, partials, gpk, idx, row_ok, rand
+            )
+            return group_sig, all_ok, row_ok
+
+        sharded = _shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                P(axis), P(axis), P(axis),
+            ),
+            out_specs=(P(axis), P(), P(axis)),
+        )
+        return jax.jit(sharded)
+
+    def _build_verify_dec(self):
+        """Per-lane attribution verify on PARSED signature lanes:
+        decompress in-program (sqrt + sign + on-curve + psi subgroup
+        check), then the pairing verify — one device dispatch for the
+        whole decode+verify stage."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local(pk, msg, sx0, sx1, sign, live):
+            sig, dec_ok = DEC.decompress_g2_graph(
+                ctx, fr_ctx, (sx0, sx1), sign
+            )
+            ok = DP.batched_verify(ctx, pk, msg, sig)
+            return jnp.logical_and(jnp.logical_and(ok, dec_ok), live)
+
+        sharded = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)
+            ),
+            out_specs=P(axis),
+        )
+        return jax.jit(sharded)
+
+    def _build_verify_rlc_dec(self):
+        """RLC verify on PARSED signature lanes. Undecodable lanes get
+        exponent 0 (neutral in the shared product) and come back False
+        in the per-lane mask output; all_ok therefore means 'every lane
+        that DECODED verified' — the host resolves per-lane results as
+        decode_mask on the fast path."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local(pk, msg, sx0, sx1, sign, live, rand):
+            sig, dec_ok = DEC.decompress_g2_graph(
+                ctx, fr_ctx, (sx0, sx1), sign
+            )
+            lane_ok = jnp.logical_and(dec_ok, live)
+            rand_live = jnp.where(lane_ok[:, None], rand, 0)
+            ok = DP.batched_verify_rlc(ctx, fr_ctx, pk, msg, sig, rand_live)
+            bad = jax.lax.psum(jnp.logical_not(ok).astype(jnp.int32), axis)
+            return bad == 0, lane_ok
+
+        sharded = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                P(axis),
+            ),
+            out_specs=(P(), P(axis)),
         )
         return jax.jit(sharded)
 
@@ -324,8 +446,9 @@ class SlotCryptoPlane:
         return blsops.bucket_lanes(n, self.shard_count())
 
     def jit_cache_size(self) -> int:
-        """Compiled-program count across this plane's four programs —
-        the bucket-discipline regression signal (see blsops counterpart)."""
+        """Compiled-program count across this plane's programs (point
+        AND decode-fused families) — the bucket-discipline regression
+        signal (see blsops counterpart)."""
         return sum(
             prog._cache_size()
             for prog in (
@@ -333,6 +456,10 @@ class SlotCryptoPlane:
                 self._step_rlc,
                 self._verify,
                 self._verify_rlc,
+                self._step_dec,
+                self._step_rlc_dec,
+                self._verify_dec,
+                self._verify_rlc_dec,
             )
         )
 
@@ -422,6 +549,38 @@ class SlotCryptoPlane:
             )
         )
 
+    def pack_verify_inputs_parsed(self, pks, msgs, parsed):
+        """Decode-mode-device pack: pk/msg POINTS (host-cached decodes)
+        plus PARSED compressed signature lanes
+        (ops/decompress.ParsedPoint, host-valid and finite — the
+        coalescer prefails the rest). Same bucket padding and trailing
+        live mask as pack_verify_inputs."""
+        n = len(pks)
+        pad = self.bucket_lanes(n) - n
+        if pad:
+            pks = list(pks) + [pks[0]] * pad
+            msgs = list(msgs) + [msgs[0]] * pad
+            parsed = list(parsed) + [parsed[0]] * pad
+        pk = C.g1_pack(self.ctx, pks)
+        msg = C.g2_pack(self.ctx, msgs)
+        sx0, sx1, sign, _inf, _ok = DEC.pack_parsed_g2(self.ctx, parsed)
+        live = jnp.asarray(np.arange(n + pad) < n)
+        return pk, msg, sx0, sx1, sign, live
+
+    def verify_packed_parsed(self, arrays, rand, n: int) -> list[bool]:
+        """Device stage for a parsed verify batch: decompression is fused
+        into the verify program (no separate decode dispatch). Lanes that
+        fail decompression on device come back False; the RLC fast path's
+        per-lane answer is exactly the decode mask."""
+        pk, msg, sx0, sx1, sign, live = arrays
+        all_ok, lane_ok = self._verify_rlc_dec(
+            pk, msg, sx0, sx1, sign, live, rand
+        )
+        if bool(all_ok):
+            return [bool(b) for b in np.asarray(lane_ok)[:n]]
+        ok = self._verify_dec(pk, msg, sx0, sx1, sign, live)
+        return [bool(b) for b in np.asarray(ok)[:n]]
+
     def verify_packed(self, arrays, rand, n: int) -> list[bool]:
         """Device stage of verify_host on an already-packed batch — the
         coalescer's pipelined flush packs on its decode pool and calls
@@ -443,6 +602,56 @@ class SlotCryptoPlane:
         arrays = self.pack_verify_inputs(pks, msgs, sigs)
         rand = self.make_lane_rand(n, rng=rng)
         return self.verify_packed(arrays, rand, n)
+
+    def pack_inputs_parsed(
+        self, pubshares, msgs, parsed_partials, group_pks, indices
+    ):
+        """Decode-mode-device recombine pack: [V, t] PARSED partial
+        signatures ride as raw limb grids; everything else is points as
+        in pack_inputs."""
+        v = len(msgs)
+        t = self.t
+        pad = self.bucket_lanes(v) - v
+        if pad:
+            pubshares = list(pubshares) + [pubshares[0]] * pad
+            msgs = list(msgs) + [msgs[0]] * pad
+            parsed_partials = (
+                list(parsed_partials) + [parsed_partials[0]] * pad
+            )
+            group_pks = list(group_pks) + [group_pks[0]] * pad
+            indices = list(indices) + [indices[0]] * pad
+        vp = v + pad
+        flat_ps = [p for row in pubshares for p in row]
+        ps = C.g1_pack(self.ctx, flat_ps)
+        ps = jax.tree_util.tree_map(lambda a: a.reshape(vp, t, -1), ps)
+        flat_parsed = [p for row in parsed_partials for p in row]
+        px0, px1, psign, _inf, _ok = DEC.pack_parsed_g2(
+            self.ctx, flat_parsed
+        )
+        px0 = px0.reshape(vp, t, -1)
+        px1 = px1.reshape(vp, t, -1)
+        psign = psign.reshape(vp, t)
+        msg = C.g2_pack(self.ctx, msgs)
+        gpk = C.g1_pack(self.ctx, group_pks)
+        idx = jnp.asarray(np.asarray(indices, np.int32))
+        live = jnp.asarray(np.arange(vp) < v)
+        return ps, msg, px0, px1, psign, gpk, idx, live
+
+    def recombine_packed_parsed(self, args, rand, v: int):
+        """Device stage for a parsed recombine batch. Rows with an
+        undecodable partial recombine as identities (their group sig
+        unpacks to None) and come back ok=False."""
+        group_sig, all_ok, row_ok = self._step_rlc_dec(*args, rand)
+        if bool(all_ok):
+            return (
+                C.g2_unpack(self.ctx, group_sig)[:v],
+                [bool(b) for b in np.asarray(row_ok)[:v]],
+            )
+        group_sig, ok, _total = self._step_dec(*args)
+        return (
+            C.g2_unpack(self.ctx, group_sig)[:v],
+            [bool(b) for b in np.asarray(ok)[:v]],
+        )
 
     def recombine_packed(self, args, rand, v: int):
         """Device stage of recombine_host on an already-packed [V, t]
@@ -481,6 +690,7 @@ class SlotCryptoPlane:
         self,
         verify_lanes=None,
         recombine_lanes=None,
+        decompress: bool = False,
     ) -> list[tuple[str, int, float]]:
         """Trace + compile the canonical duty shapes up front so the
         first live slot never eats a cold pairing compile on the duty
@@ -530,4 +740,38 @@ class SlotCryptoPlane:
             np.asarray(self.step(*args)[1])
             report.append(("recombine", self.bucket_lanes(v),
                            _time.monotonic() - t0))
+        if decompress:
+            # decode-fused programs (decode_mode device): same buckets,
+            # generator-point encodings so decompression takes the live
+            # (finite, subgroup-valid) path through the sqrt chain
+            from charon_tpu.crypto.g1g2 import g2_to_bytes
+
+            gen_parsed = DEC.parse_g2_lane(g2_to_bytes(G2_GEN))
+            for n in verify_lanes:
+                t0 = _time.monotonic()
+                arrays = self.pack_verify_inputs_parsed(
+                    [G1_GEN] * n, [G2_GEN] * n, [gen_parsed] * n
+                )
+                rand = self.make_lane_rand(n)
+                pk, msg, sx0, sx1, sign, live = arrays
+                bool(
+                    self._verify_rlc_dec(pk, msg, sx0, sx1, sign, live, rand)[0]
+                )
+                np.asarray(self._verify_dec(pk, msg, sx0, sx1, sign, live))
+                report.append(("verify-dec", self.bucket_lanes(n),
+                               _time.monotonic() - t0))
+            for v in recombine_lanes:
+                t0 = _time.monotonic()
+                args = self.pack_inputs_parsed(
+                    [[G1_GEN] * t] * v,
+                    [G2_GEN] * v,
+                    [[gen_parsed] * t] * v,
+                    [G1_GEN] * v,
+                    [idx_row] * v,
+                )
+                rand = self.make_rand(v)
+                self._step_rlc_dec(*args, rand)
+                np.asarray(self._step_dec(*args)[1])
+                report.append(("recombine-dec", self.bucket_lanes(v),
+                               _time.monotonic() - t0))
         return report

@@ -74,9 +74,22 @@ class TPUImpl(Implementation):
     verification before store).
     """
 
-    def __init__(self, engine: blsops.BlsEngine | None = None, verify_inputs: bool = True):
+    def __init__(
+        self,
+        engine: blsops.BlsEngine | None = None,
+        verify_inputs: bool = True,
+        decode_mode: str = "auto",
+    ):
         self.engine = engine or blsops.default_engine()
         self.verify_inputs = verify_inputs
+        # signature decompression routing (ISSUE 5): "device" batches the
+        # Fp2 sqrt + sign + psi subgroup check into one kernel (folding
+        # the separate subgroup_check_g2_batch dispatch), "python" keeps
+        # the host bigint path, "auto" = device on TPU backends only —
+        # the python rung stays the degradation floor below it.
+        if decode_mode not in ("auto", "device", "python"):
+            raise ValueError(f"bad decode_mode {decode_mode!r}")
+        self.decode_mode = decode_mode
         self._host = PythonImpl()
         # degradation ladder for device failures in the RLC batch path
         # (mirrors bench.py): Pippenger MSM off first (the newest kernel
@@ -102,9 +115,28 @@ class TPUImpl(Implementation):
 
     # -- decompression helpers -------------------------------------------
 
+    def _device_decode(self) -> bool:
+        if self.decode_mode != "auto":
+            return self.decode_mode == "device"
+        return limb._is_tpu_backend()
+
     def _sig_points(self, sigs: Sequence[bytes], what: str) -> list:
-        """Decompress signatures on host (no subgroup check — that runs
-        batched on device when verify_inputs is set)."""
+        """Decompress signatures — the bulk path runs the whole decode
+        (sqrt + sign + on-curve + subgroup) as ONE device program
+        (ops/decompress.py); the python rung decompresses on host and
+        pays a separate subgroup dispatch when verify_inputs is set."""
+        if self._device_decode():
+            pts, valid = self.engine.decompress_g2_batch(
+                sigs, subgroup_check=self.verify_inputs
+            )
+            for pt, ok in zip(pts, valid):
+                if not ok:
+                    raise TblsError(
+                        f"{what} failed decompression or subgroup check"
+                    )
+                if pt is None:
+                    raise TblsError(f"infinite {what}")
+            return pts
         pts = []
         for sig in sigs:
             pt = sig_to_point(sig, subgroup_check=False)
@@ -136,11 +168,26 @@ class TPUImpl(Implementation):
         msgs: list = [None] * n
         sigs: list = [None] * n
         ok = [True] * n
+        device_decode = self._device_decode()
+        if device_decode:
+            # one device program decompresses AND subgroup-checks every
+            # signature lane — the separate subgroup_check_g2_batch
+            # dispatch below is folded away (ISSUE 5). Malformed lanes
+            # stay per-lane False (None points contribute neutrally).
+            sigs, sig_ok = self.engine.decompress_g2_batch(
+                [sig for _, _, sig in items],
+                subgroup_check=self.verify_inputs,
+            )
+            for i in range(n):
+                if not sig_ok[i] or sigs[i] is None:
+                    ok[i] = False
+                    sigs[i] = None
         for i, (pk, data, sig) in enumerate(items):
             try:
                 pks[i] = _cached_pubkey_point(pk)
                 msgs[i] = _cached_msg_point(data)
-                sigs[i] = sig_to_point(sig, subgroup_check=False)
+                if not device_decode:
+                    sigs[i] = sig_to_point(sig, subgroup_check=False)
                 if sigs[i] is None:
                     raise TblsError("infinite signature")
             except TblsError:
@@ -158,10 +205,17 @@ class TPUImpl(Implementation):
             verified = [True] * n
         else:
             verified = self.engine.verify_batch(pks, msgs, sigs)
-        if self.verify_inputs:
-            in_subgroup = self.engine.subgroup_check_g2_batch(sigs)
-        else:
-            in_subgroup = [True] * n
+        in_subgroup = [True] * n
+        if self.verify_inputs and not device_decode:
+            # ship only lanes that decoded: known-False lanes (None)
+            # would pad the batch for a check whose answer is unused
+            live = [i for i in range(n) if sigs[i] is not None]
+            if live:
+                checked = self.engine.subgroup_check_g2_batch(
+                    [sigs[i] for i in live]
+                )
+                for i, s in zip(live, checked):
+                    in_subgroup[i] = s
         return [o and v and s for o, v, s in zip(ok, verified, in_subgroup)]
 
     def _rlc_guarded(self, items, pks, msgs, sigs) -> bool:

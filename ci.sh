@@ -25,14 +25,19 @@ PYTEST=(python -m pytest -q -p no:cacheprovider)
 
 case "$TIER" in
   fast)
-    # Wall-clock budget: ~3 min unloaded, <15 min on a loaded 1-core VM
+    # Wall-clock budget: ~5 min unloaded, <15 min on a loaded 1-core VM
     # (mirrors the reference's 5-minute unit guard). Includes the chaos
     # scenario suite under its fixed seed (tests/test_chaos_scenarios.py
     # SEED) — the -m default in pytest.ini already deselects slow —
-    # plus the hostplane smoke (ISSUE 3): event-loop-stall regressions
-    # in the pipelined crypto coalescer fail the fast tier — and the
-    # obs gate's fast subset (ISSUE 4): a 1-duty simnet must export
-    # duty-rooted spans through the monitoring endpoint.
+    # the decompression kernel-vs-oracle batteries (ISSUE 5,
+    # tests/test_decompress.py: one compile per kernel config, ~70 s on
+    # a cold 1-core VM) and their bucket-ladder jit-cache gate
+    # (tests/test_hostplane.py, compile-free) — plus the hostplane
+    # smoke (ISSUE 3 + 5): event-loop-stall regressions in the
+    # pipelined crypto coalescer AND a decode-stage host-CPU ratio
+    # below 5x (python rung vs device-rung host parse) fail the fast
+    # tier — and the obs gate's fast subset (ISSUE 4): a 1-duty simnet
+    # must export duty-rooted spans through the monitoring endpoint.
     "${PYTEST[@]}" tests/ -m 'not slow' --continue-on-collection-errors
     python bench_hostplane.py --smoke
     exec python obs_check.py --fast
@@ -40,8 +45,10 @@ case "$TIER" in
   hostplane)
     # Wall-clock budget: ~30 s. Tiny shapes, CPU, no jax: asserts the
     # coalescer's decode pool keeps event-loop stall >= 3x below the
-    # synchronous path and that double-buffered flushes overlap host
-    # decode with the in-flight device program (bench_hostplane.py).
+    # synchronous path, that double-buffered flushes overlap host
+    # decode with the in-flight device program, and that the device
+    # decode rung's host-side parse beats the python bigint decode by
+    # >= 5x host CPU per burst (bench_hostplane.py, ISSUE 5).
     exec python bench_hostplane.py --smoke
     ;;
   slow)

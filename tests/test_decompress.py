@@ -1,0 +1,312 @@
+"""Batched point decompression (ISSUE 5): the device kernel family in
+ops/decompress.py vs the g1g2 host oracle.
+
+The contract under test is per-lane masking: random round-trips, both
+y sign bits, infinity encodings, bad flag bits, x >= p, non-residue x
+(no point on the curve) and non-subgroup on-curve points ALL come back
+as per-lane (point, valid) outcomes with ZERO mask mismatches against
+`g1_from_bytes`/`g2_from_bytes` — never exceptions.
+
+Kernel batteries pack every edge class into ONE batch per kernel config
+so the fast tier pays exactly one compile per group (the bucket ladder
+keeps it one program; see test_hostplane's jit-cache gate for the
+many-shapes bound). Host-parse and psi-oracle tests are jax-free.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.crypto import g1g2
+from charon_tpu.ops import decompress as DEC
+
+P = F.P
+_COMPRESSED = 0x80
+_INFINITY = 0x40
+_LEX_LARGEST = 0x20
+
+_RNG = random.Random(5)
+
+
+# ---------------------------------------------------------------------------
+# deterministic test-vector builders (host, pure ints)
+# ---------------------------------------------------------------------------
+
+
+def _rand_g2() -> tuple:
+    return g1g2.g2_mul_raw(g1g2.G2_GEN, _RNG.randrange(1, F.R))
+
+
+def _rand_g1() -> tuple:
+    return g1g2.g1_mul_raw(g1g2.G1_GEN, _RNG.randrange(1, F.R))
+
+
+def _g2_on_curve_not_in_subgroup() -> tuple:
+    """Random on-curve G2 point: with cofactor ~2^382 the subgroup
+    probability is negligible; asserted anyway."""
+    while True:
+        x = (_RNG.randrange(P), _RNG.randrange(P))
+        y = F.fp2_sqrt(F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g1g2.B2))
+        if y is None:
+            continue
+        pt = (x, y)
+        if not g1g2.g2_in_subgroup(pt):
+            return pt
+
+
+def _g1_on_curve_not_in_subgroup() -> tuple:
+    while True:
+        x = _RNG.randrange(P)
+        y = F.fp_sqrt((x * x * x + g1g2.B1) % P)
+        if y is None:
+            continue
+        pt = (x, y)
+        if not g1g2.g1_in_subgroup(pt):
+            return pt
+
+
+def _g2_nonresidue_x_bytes() -> bytes:
+    """Encoding whose x is NOT on the curve (x^3 + b a non-residue)."""
+    while True:
+        x = (_RNG.randrange(P), _RNG.randrange(P))
+        if F.fp2_sqrt(F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g1g2.B2)) is None:
+            out = bytearray(x[1].to_bytes(48, "big") + x[0].to_bytes(48, "big"))
+            out[0] |= _COMPRESSED
+            return bytes(out)
+
+
+def _g1_nonresidue_x_bytes() -> bytes:
+    while True:
+        x = _RNG.randrange(P)
+        if F.fp_sqrt((x * x * x + g1g2.B1) % P) is None:
+            out = bytearray(x.to_bytes(48, "big"))
+            out[0] |= _COMPRESSED
+            return bytes(out)
+
+
+def _flip_sign(enc: bytes) -> bytes:
+    out = bytearray(enc)
+    out[0] ^= _LEX_LARGEST
+    return bytes(out)
+
+
+def _g2_oracle(data: bytes, subgroup: bool = True):
+    """(valid, point) the way the device mask must see it. Wrong-length
+    lanes are a host-parse reject (the oracle raises on them too)."""
+    try:
+        return True, g1g2.g2_from_bytes(bytes(data), subgroup_check=subgroup)
+    except ValueError:
+        return False, None
+
+
+def _g1_oracle(data: bytes, subgroup: bool = True):
+    try:
+        return True, g1g2.g1_from_bytes(bytes(data), subgroup_check=subgroup)
+    except ValueError:
+        return False, None
+
+
+def _g2_battery() -> list[tuple[str, bytes]]:
+    """Every edge class of the mask contract, labelled."""
+    lanes: list[tuple[str, bytes]] = []
+    for i in range(6):
+        enc = g1g2.g2_to_bytes(_rand_g2())
+        lanes.append((f"roundtrip-{i}", enc))
+        if i < 2:  # both sign bits for the same x
+            lanes.append((f"signflip-{i}", _flip_sign(enc)))
+    lanes.append(("infinity", g1g2.g2_to_bytes(None)))
+    bad_inf = bytearray(g1g2.g2_to_bytes(None))
+    bad_inf[50] = 7  # payload must be all-zero
+    lanes.append(("bad-infinity-payload", bytes(bad_inf)))
+    bad_inf2 = bytearray(g1g2.g2_to_bytes(None))
+    bad_inf2[0] |= _LEX_LARGEST  # sign bit forbidden on infinity
+    lanes.append(("bad-infinity-sign", bytes(bad_inf2)))
+    no_flag = bytearray(g1g2.g2_to_bytes(_rand_g2()))
+    no_flag[0] &= 0x7F  # compressed bit missing
+    lanes.append(("no-compressed-flag", bytes(no_flag)))
+    big_x = bytearray(P.to_bytes(48, "big") + (1).to_bytes(48, "big"))
+    big_x[0] |= _COMPRESSED
+    lanes.append(("x-ge-p", bytes(big_x)))
+    lanes.append(("non-residue-x", _g2_nonresidue_x_bytes()))
+    lanes.append(
+        ("non-subgroup", g1g2.g2_to_bytes(_g2_on_curve_not_in_subgroup()))
+    )
+    lanes.append(("wrong-length", b"\x80" + bytes(40)))
+    lanes.append(("empty", b""))
+    return lanes
+
+
+def _g1_battery() -> list[tuple[str, bytes]]:
+    lanes: list[tuple[str, bytes]] = []
+    for i in range(3):
+        enc = g1g2.g1_to_bytes(_rand_g1())
+        lanes.append((f"roundtrip-{i}", enc))
+        if i < 1:
+            lanes.append((f"signflip-{i}", _flip_sign(enc)))
+    lanes.append(("infinity", g1g2.g1_to_bytes(None)))
+    bad_inf = bytearray(g1g2.g1_to_bytes(None))
+    bad_inf[20] = 3
+    lanes.append(("bad-infinity-payload", bytes(bad_inf)))
+    no_flag = bytearray(g1g2.g1_to_bytes(_rand_g1()))
+    no_flag[0] &= 0x7F
+    lanes.append(("no-compressed-flag", bytes(no_flag)))
+    big_x = bytearray(P.to_bytes(48, "big"))
+    big_x[0] |= _COMPRESSED
+    lanes.append(("x-ge-p", bytes(big_x)))
+    lanes.append(("non-residue-x", _g1_nonresidue_x_bytes()))
+    lanes.append(
+        ("non-subgroup", g1g2.g1_to_bytes(_g1_on_curve_not_in_subgroup()))
+    )
+    lanes.append(("wrong-length", b"\x80" + bytes(20)))
+    return lanes
+
+
+# ---------------------------------------------------------------------------
+# host parse (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_g2_lane_edge_classes():
+    for label, enc in _g2_battery():
+        parsed = DEC.parse_g2_lane(enc)
+        assert isinstance(parsed, DEC.ParsedPoint), label
+        assert parsed.raw == enc, label
+        # the host verdict is a SUPERSET of the oracle's failures: when
+        # parse rejects, the oracle must reject too (never the device's
+        # job to resurrect a lane), and parse-ok infinity lanes decode
+        # to None
+        if not parsed.ok:
+            assert not _g2_oracle(enc)[0], label
+            assert parsed.x0 == parsed.x1 == 0, label
+        elif parsed.infinity:
+            assert _g2_oracle(enc) == (True, None), label
+
+
+def test_parse_g1_lane_edge_classes():
+    for label, enc in _g1_battery():
+        parsed = DEC.parse_g1_lane(enc)
+        assert parsed.raw == enc, label
+        if not parsed.ok:
+            assert not _g1_oracle(enc)[0], label
+        elif parsed.infinity:
+            assert _g1_oracle(enc) == (True, None), label
+
+
+def test_parse_never_raises_on_fuzz():
+    rng = random.Random(11)
+    for _ in range(300):
+        blob = bytes(
+            rng.randrange(256) for _ in range(rng.choice((0, 1, 47, 48, 95, 96, 97)))
+        )
+        DEC.parse_g2_lane(blob)
+        DEC.parse_g1_lane(blob)
+
+
+# ---------------------------------------------------------------------------
+# psi endomorphism host oracle (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_psi_subgroup_oracle_matches_full_ladder():
+    """g2_in_subgroup_psi (the 64-bit ladder the device kernel mirrors)
+    agrees with the [r]P definition on subgroup points, on-curve
+    non-subgroup points, and identity."""
+    for _ in range(4):
+        assert g1g2.g2_in_subgroup_psi(_rand_g2())
+    for _ in range(2):
+        pt = _g2_on_curve_not_in_subgroup()
+        assert not g1g2.g2_in_subgroup_psi(pt)
+        assert not g1g2.g2_in_subgroup(pt)
+    assert g1g2.g2_in_subgroup_psi(None)
+
+
+def test_psi_is_endomorphism_acting_as_x():
+    """psi(P) == [-x_abs]P on G2 (the identity the fast check rests on)."""
+    pt = _rand_g2()
+    assert g1g2.g2_psi(pt) == g1g2.g2_neg(g1g2.g2_mul_raw(pt, F.X_ABS))
+
+
+# ---------------------------------------------------------------------------
+# device kernel vs oracle (one compile per battery)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_g2_kernel_vs_oracle_zero_mask_mismatches():
+    from charon_tpu.ops import blsops
+
+    battery = _g2_battery()
+    labels = [label for label, _ in battery]
+    encs = [enc for _, enc in battery]
+    pts, valid = blsops.default_engine().decompress_g2_batch(encs)
+    assert len(pts) == len(valid) == len(encs)
+    for label, enc, pt, ok in zip(labels, encs, pts, valid):
+        want_ok, want_pt = _g2_oracle(enc)
+        assert ok == want_ok, f"{label}: mask mismatch (got {ok})"
+        if want_ok:
+            assert pt == want_pt, f"{label}: point mismatch"
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_g2_kernel_subgroup_check_off_accepts_torsion():
+    """subgroup_check=False must accept the on-curve non-subgroup point
+    (and still reject malformed lanes) — the rung TPUImpl uses when the
+    caller already verified inputs."""
+    from charon_tpu.ops import blsops
+
+    pt = _g2_on_curve_not_in_subgroup()
+    encs = [
+        g1g2.g2_to_bytes(pt),
+        g1g2.g2_to_bytes(_rand_g2()),
+        _g2_nonresidue_x_bytes(),
+    ]
+    pts, valid = blsops.default_engine().decompress_g2_batch(
+        encs, subgroup_check=False
+    )
+    assert valid == [True, True, False]
+    assert pts[0] == pt
+    assert pts[0] is not None and not g1g2.g2_in_subgroup(pts[0])
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_g1_kernel_vs_oracle_zero_mask_mismatches():
+    from charon_tpu.ops import blsops
+
+    battery = _g1_battery()
+    encs = [enc for _, enc in battery]
+    pts, valid = blsops.default_engine().decompress_g1_batch(encs)
+    for (label, enc), pt, ok in zip(battery, pts, valid):
+        want_ok, want_pt = _g1_oracle(enc)
+        assert ok == want_ok, f"{label}: mask mismatch (got {ok})"
+        if want_ok:
+            assert pt == want_pt, f"{label}: point mismatch"
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings("ignore")
+def test_g2_kernel_random_roundtrip_volume():
+    """Wider random sweep (slow tier): 64 fresh subgroup points with
+    whichever sign bits they land on, plus interleaved rejects, all in
+    one larger bucket."""
+    from charon_tpu.ops import blsops
+
+    rng = random.Random(13)
+    encs = []
+    for i in range(64):
+        if i % 8 == 7:
+            encs.append(_g2_nonresidue_x_bytes())
+        else:
+            encs.append(
+                g1g2.g2_to_bytes(
+                    g1g2.g2_mul_raw(g1g2.G2_GEN, rng.randrange(1, F.R))
+                )
+            )
+    pts, valid = blsops.default_engine().decompress_g2_batch(encs)
+    for enc, pt, ok in zip(encs, pts, valid):
+        want_ok, want_pt = _g2_oracle(enc)
+        assert ok == want_ok
+        if want_ok:
+            assert pt == want_pt

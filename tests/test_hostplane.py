@@ -405,6 +405,201 @@ def test_blsops_engine_pads_to_same_ladder(monkeypatch):
         blsops.clear_kernel_caches()  # drop fakes for later tests
 
 
+class ParsedFakePlane(FakePlane):
+    """FakePlane + the packed AND parsed plane APIs, so the coalescer's
+    decode_mode=device routing and its step-down ladder are drivable
+    without jax. `fail_parsed` primes the next N parsed device calls to
+    raise (the injected decode-kernel failure)."""
+
+    def __init__(self, t: int, fail_parsed: int = 0):
+        super().__init__(t)
+        self.fail_parsed = fail_parsed
+        self.parsed_verify_calls = 0
+
+    def pack_verify_inputs(self, pks, msgs, sigs):
+        import numpy as np
+
+        return ("v", np.empty(len(pks)))
+
+    def pack_verify_inputs_parsed(self, pks, msgs, parsed):
+        import numpy as np
+
+        from charon_tpu.ops import decompress as DEC
+
+        assert all(isinstance(p, DEC.ParsedPoint) for p in parsed)
+        return ("vp", np.empty(len(pks)))
+
+    def make_lane_rand(self, n: int, rng=None):
+        return n
+
+    def verify_packed(self, arrays, rand, n: int):
+        return self.verify_host([None] * n, None, None)
+
+    def verify_packed_parsed(self, arrays, rand, n: int):
+        self.parsed_verify_calls += 1
+        if self.fail_parsed > 0:
+            self.fail_parsed -= 1
+            raise RuntimeError("injected parsed-kernel failure")
+        return [True] * n
+
+    def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):
+        import numpy as np
+
+        return ("r", np.empty(len(msgs)))
+
+    pack_inputs_parsed = pack_inputs
+
+    def make_rand(self, v: int, rng=None):
+        return v
+
+    def recombine_packed(self, args, rand, v: int):
+        return [None] * v, [True] * v
+
+    recombine_packed_parsed = recombine_packed
+
+
+def test_decode_mode_device_routes_parsed_lanes():
+    """decode_mode=device ships PARSED signature lanes to the parsed
+    plane API; host-parse rejects still fail per-lane on host; stats
+    carry the device decode-source breakdown."""
+    stats = []
+    plane = ParsedFakePlane(T)
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device", stats_hook=stats.append)
+    items = _sig_items(3)
+    items.append((items[0][0], b"\x01" * 32, b"\x00" * 96))  # bad flags
+    try:
+        assert asyncio.run(coal.verify(items)) == [True, True, True, False]
+    finally:
+        coal.close()
+    assert plane.parsed_verify_calls == 1 and plane.verify_calls == 0
+    assert stats[-1].decode_mode == "device"
+    assert stats[-1].decode_device_lanes == 3
+    assert stats[-1].decode_python_lanes == 0
+
+
+def test_parsed_flush_failure_steps_decode_down_and_retries():
+    """A device failure in a parsed flush steps the decode rung down to
+    python PERMANENTLY and retries the SAME batch through the point
+    path — without burning the process-wide msm-off rung."""
+    plane = ParsedFakePlane(T, fail_parsed=1)
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device")
+    items = _sig_items(2)
+    try:
+        assert asyncio.run(coal.verify(items)) == [True, True]
+        assert coal._decode_live == "python"
+        assert not coal._degraded  # decode rung absorbed it, not msm-off
+        assert plane.parsed_verify_calls == 1
+        first_point_calls = plane.verify_calls
+        assert first_point_calls >= 1  # the converted retry
+        # subsequent flushes decode on the python rung directly
+        assert asyncio.run(coal.verify(items)) == [True, True]
+        assert plane.parsed_verify_calls == 1
+        assert plane.verify_calls == first_point_calls + 1
+    finally:
+        coal.close()
+
+
+def test_stepdown_retry_applies_when_rung_already_python():
+    """Double-buffered regression: a second in-flight PARSED flush can
+    fail after a sibling already stepped the rung down. Applicability is
+    judged by the batch (parsed lanes shipped), not the current rung —
+    the retry must land here, never on the msm-off rung."""
+    from charon_tpu.core.cryptoplane import _VerifyJob, _parse_verify_lane
+
+    plane = ParsedFakePlane(T)
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device")
+    assert coal._decode_rung() == "device"
+    lanes = [_parse_verify_lane(it) for it in _sig_items(2)]
+
+    async def drive():
+        fut = asyncio.get_running_loop().create_future()
+        vq = [_VerifyJob(lanes=lanes, fut=fut)]
+        coal._decode_live = "python"  # sibling flush stepped down first
+        return await coal._decode_stepdown_and_retry(
+            vq, [], RuntimeError("injected kernel failure")
+        )
+
+    try:
+        res = asyncio.run(drive())
+    finally:
+        coal.close()
+    assert res is not None  # retried here, not passed down the ladder
+    vres, rres = res
+    assert vres == [[True, True]] and rres == []
+    assert plane.verify_calls == 1 and not coal._degraded
+
+
+def test_decode_breakdown_mode_falls_back_to_live_rung():
+    """A flush whose every signature lane prefailed on host parse must
+    report the rung in force, not fake a ladder step-down (the
+    tpu_plane_decode_mode gauge contract)."""
+    from charon_tpu.core.cryptoplane import _VerifyJob
+
+    plane = ParsedFakePlane(T)
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device")
+    try:
+        coal._decode_live = "device"
+        job = _VerifyJob(lanes=[None, None], fut=None)
+        mode, cache, device, python = coal._decode_breakdown([job], [])
+        assert (mode, cache, device, python) == ("device", 0, 0, 0)
+    finally:
+        coal.close()
+
+
+def test_decompress_kernel_family_stays_on_bucket_ladder(monkeypatch):
+    """The ISSUE 5 decompression kernels ride the SAME pow2 ladder as
+    the flush programs: 50 random decompress_g2_batch sizes compile at
+    most one program per bucket per (subgroup flag) config — growth is
+    O(log max_batch), asserted by compiled-program count. Field work is
+    monkeypatched to a shape-faithful fake BEFORE any trace, so the test
+    is compile-free; the jit accounting is the real one."""
+    import random
+
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import blsops
+    from charon_tpu.ops import decompress as DEC
+
+    traced_shapes: list[int] = []
+
+    def fake_dec(ctx, fr_ctx, x_raw, sign, infinity=None, host_ok=None,
+                 subgroup=True):
+        x0 = x_raw[0] if isinstance(x_raw, tuple) else x_raw
+        traced_shapes.append(int(x0.shape[0]))
+        return (x_raw, x_raw), jnp.ones(x0.shape[:-1], bool)
+
+    monkeypatch.setattr(DEC, "decompress_g2_graph", fake_dec)
+    monkeypatch.setattr(DEC, "decompress_g1_graph", fake_dec)
+    blsops.clear_kernel_caches()  # rebuild wrappers over the fakes
+    try:
+        engine = blsops.BlsEngine()
+        from charon_tpu.crypto.g1g2 import g2_to_bytes
+
+        rng = random.Random(17)
+        sizes = [rng.randrange(1, 200) for _ in range(50)]
+        enc = g2_to_bytes(None)  # parse-valid infinity lane
+        for n in sizes:
+            pts, valid = engine.decompress_g2_batch([enc] * n)
+            assert len(valid) == n
+        ladder = {blsops.bucket_lanes(n) for n in sizes}
+        # one compiled program per bucket, for ONE kernel config
+        # (subgroup_check=True) — the trace count equals the ladder
+        assert sorted(set(traced_shapes)) == sorted(ladder)
+        assert len(traced_shapes) == len(ladder) <= 8
+        assert blsops.jit_cache_size() == len(ladder)
+        # the second config (subgroup off) adds at most one ladder more,
+        # never one per flush
+        for n in sizes[:20]:
+            engine.decompress_g2_batch([enc] * n, subgroup_check=False)
+        assert blsops.jit_cache_size() <= 2 * len(ladder)
+    finally:
+        blsops.clear_kernel_caches()  # drop fakes for later tests
+
+
 def test_coalescer_prewarm_reports_bucket_shapes(monkeypatch):
     """SlotCoalescer.prewarm compiles the canonical duty shapes via the
     plane hook on the device lane (compile-free here: pairing faked)."""
