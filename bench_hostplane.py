@@ -28,6 +28,17 @@ decompression dominates). With --decode-mode device (or --smoke) the
 run FAILS unless the device rung cuts decode host CPU by
 --assert-decode-ratio (default 5x), measured twice before concluding.
 
+Cold-start A/B (ISSUE 6): `--cold-start` measures the COLD path — a
+cache-flushed burst where every message pays hash-to-curve — as host
+CPU per burst for the python rung (full SSWU + isogeny + cofactor
+clearing per message, `crypto/h2c.py`) vs the device path's host half
+(`ops/sswu.hash_to_field_lane`: expand_message_xmd + hash_to_field,
+SHA-256 only — the field work ships to the batched device kernel).
+The run FAILS unless the device path cuts cold-burst host CPU by
+--assert-h2c-ratio (default 5x, measured twice before concluding).
+Passed alone it runs just the A/B (a quick sizing tool for the
+`--crypto-plane-warmup` flag); `--smoke` includes the gate.
+
 `--smoke` (ci.sh fast tier) runs tiny shapes and FAILS (exit 1) when
 the stall improvement ratio drops below --assert-ratio or the overlap
 hits zero — the event-loop-stall regression guard.
@@ -281,6 +292,34 @@ def measure_decode_host(items, mode: str) -> float:
     return elapsed
 
 
+def h2c_cold_ab(lanes: int) -> dict:
+    """The Round-8 A/B: host CPU for a cache-flushed message burst —
+    python hash-to-curve (what every cache miss pays today) vs the
+    host half of the device path (SHA-256 hashing only; SSWU +
+    3-isogeny + psi cofactor clearing run as ONE batched device
+    program). thread_time, so scheduler noise is excluded; both sides
+    see the same fresh messages (no cache can help either)."""
+    from charon_tpu.ops import sswu
+    from charon_tpu.tbls.tpu_impl import _decode_msg_point
+
+    msgs = [b"cold-%d" % i for i in range(lanes)]
+    t0 = time.thread_time()
+    for m in msgs:
+        _decode_msg_point(m)  # full python h2c — bypasses the cache
+    py_s = time.thread_time() - t0
+    t0 = time.thread_time()
+    hashed = [sswu.hash_to_field_lane(m) for m in msgs]
+    dev_s = time.thread_time() - t0
+    assert len(hashed) == lanes
+    return {
+        "lanes": lanes,
+        "python_h2c_host_seconds": round(py_s, 4),
+        "device_h2c_host_seconds": round(dev_s, 6),
+        "h2c_host_cpu_ratio": round(py_s / max(dev_s, 1e-9), 1),
+        "python_ms_per_lane": round(py_s / lanes * 1000, 2),
+    }
+
+
 def decode_ab(items) -> dict:
     """The Round-7 A/B: decode-stage host CPU per burst, python rung vs
     device rung (parse-only host work; field arithmetic on device)."""
@@ -294,8 +333,39 @@ def decode_ab(items) -> dict:
     }
 
 
+def _run_h2c_gate(lanes: int, want: float) -> tuple[dict, bool]:
+    """Measure the cold-start h2c A/B, remeasuring once before failing
+    the gate (CI-noise discipline shared with the other gates)."""
+    ab = h2c_cold_ab(lanes)
+    if want and ab["h2c_host_cpu_ratio"] < want:
+        print(f"# h2c cold ratio {ab['h2c_host_cpu_ratio']}x < "
+              f"{want}x — remeasuring")
+        ab = h2c_cold_ab(lanes)
+    ok = not want or ab["h2c_host_cpu_ratio"] >= want
+    print(
+        f"# cold-start h2c host CPU/burst ({ab['lanes']} lanes): python "
+        f"{ab['python_h2c_host_seconds'] * 1000:.0f} ms "
+        f"({ab['python_ms_per_lane']} ms/lane) -> device-path host "
+        f"{ab['device_h2c_host_seconds'] * 1000:.1f} ms "
+        f"({ab['h2c_host_cpu_ratio']}x)"
+    )
+    return ab, ok
+
+
 async def main(args) -> int:
     lanes = 32 if args.smoke else args.lanes
+    if args.cold_start and not args.smoke:
+        # standalone cold-start A/B: the sizing tool for
+        # --crypto-plane-warmup (docs/operations.md), gated like smoke
+        ab, ok = _run_h2c_gate(lanes, args.assert_h2c_ratio)
+        print(json.dumps({"bench": "hostplane-cold-start",
+                          "h2c_cold_ab": ab}, indent=2))
+        if not ok:
+            print(f"FAIL: device h2c path cut cold-burst host CPU only "
+                  f"{ab['h2c_host_cpu_ratio']}x < {args.assert_h2c_ratio}x")
+            return 1
+        print("cold-start PASS")
+        return 0
     print(f"# generating {lanes}-lane burst (pure-python signing) ...")
     t0 = time.monotonic()
     items = make_burst(lanes)
@@ -351,6 +421,12 @@ async def main(args) -> int:
               f"{want_decode}x — remeasuring")
         ab = decode_ab(items)
         decode_attempts += 1
+    # cold-start h2c A/B (ISSUE 6): measured AND gated only under
+    # --smoke / --cold-start — a plain stall/overlap run should not pay
+    # ~20 ms/lane of python hash-to-curve for an unenforced number
+    h2c_ab, h2c_ok = None, True
+    if args.smoke or args.cold_start:
+        h2c_ab, h2c_ok = _run_h2c_gate(lanes, args.assert_h2c_ratio)
     report = {
         "bench": "hostplane",
         "smoke": args.smoke,
@@ -359,6 +435,7 @@ async def main(args) -> int:
         "stall_improvement_ratio": round(ratio, 1),
         "measure_attempts": attempts,
         "decode_ab": ab,
+        **({"h2c_cold_ab": h2c_ab} if h2c_ab else {}),
     }
     print(json.dumps(report, indent=2))
     print(
@@ -378,6 +455,12 @@ async def main(args) -> int:
             f"FAIL: device decode rung cut host CPU only "
             f"{ab['decode_host_cpu_ratio']}x < {want_decode}x "
             f"on {decode_attempts} attempts"
+        )
+        return 1
+    if not h2c_ok:
+        print(
+            f"FAIL: device h2c path cut cold-burst host CPU only "
+            f"{h2c_ab['h2c_host_cpu_ratio']}x < {args.assert_h2c_ratio}x"
         )
         return 1
     if want:
@@ -426,4 +509,13 @@ if __name__ == "__main__":
                     help="with --decode-mode device or --smoke: fail "
                     "unless the device rung cuts decode-stage host CPU "
                     "by at least this factor (ISSUE 5 acceptance)")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="cold-path A/B: cache-flushed h2c burst, "
+                    "python hash-to-curve vs the device path's host "
+                    "half; alone it runs just the A/B, with --smoke "
+                    "the gate joins the tier")
+    ap.add_argument("--assert-h2c-ratio", type=float, default=5.0,
+                    help="with --cold-start or --smoke: fail unless "
+                    "the device h2c path cuts cold-burst host CPU by "
+                    "at least this factor (ISSUE 6 acceptance)")
     raise SystemExit(asyncio.run(main(ap.parse_args())))

@@ -232,6 +232,26 @@ class ClusterMetrics:
             labels + ["cache"],
             registry=self.registry,
         )
+        # cold-start observability (ISSUE 6): the bulk point-cache
+        # warm-up path — lanes decoded per warm pass by cache and
+        # source (device = sharded bulk kernels, python = host bigint
+        # rung, cached = already warm, invalid = rejected lanes), plus
+        # wall seconds per warm pass
+        self.point_cache_warmup_lanes = counter(
+            "tpu_point_cache_warmup_lanes_total",
+            "Point-cache warm-up lanes by cache (pubkey decompression / "
+            "message hash-to-curve) and source (device bulk kernels, "
+            "python host decode, cached = skipped, invalid = rejected)",
+            ["cache", "source"],
+        )
+        self.point_cache_warmup_seconds = Histogram(
+            "tpu_point_cache_warmup_seconds",
+            "Wall seconds per bulk warm-up pass (startup or "
+            "validator-set rotation)",
+            labels,
+            registry=self.registry,
+            buckets=(0.05, 0.2, 1.0, 5.0, 20.0, 60.0, 300.0),
+        )
         # duty-rooted tracing (ISSUE 4): per-step latency from span
         # ends plus the slow-duty detector's wall-time/budget verdicts
         self.step_latency = Histogram(
@@ -277,6 +297,20 @@ class ClusterMetrics:
             self.labels(self.point_cache_hits, name).set(info.hits)
             self.labels(self.point_cache_misses, name).set(info.misses)
             self.labels(self.point_cache_size, name).set(info.currsize)
+
+    def observe_warmup(self, stats: dict) -> None:
+        """Record one bulk warm-up pass (the stats dict returned by
+        tpu_impl.warm_point_caches / SlotCoalescer.warm_caches).
+        Thread-safe — warm-up runs on its own worker thread."""
+        for cache in ("pubkey", "message"):
+            for source, count in stats.get(cache, {}).items():
+                if count:
+                    self.labels(
+                        self.point_cache_warmup_lanes, cache, source
+                    ).inc(count)
+        self.labels(self.point_cache_warmup_seconds).observe(
+            max(0.0, float(stats.get("seconds", 0.0)))
+        )
 
     def render(self) -> bytes:
         self.observe_point_caches()

@@ -84,6 +84,13 @@ class Config:
     # only on a real accelerator backend (CPU test runs skip the
     # minutes-long pairing compiles), "on" forces, "off" disables
     crypto_plane_prewarm: str = "auto"
+    # bulk point-cache warm-up at startup (ISSUE 6): decode every
+    # cluster pubshare/group key through the batched device kernels so
+    # the first live slot starts at a warm cache instead of paying a
+    # python-bigint burst; "auto" warms on real accelerator backends
+    # only, "on" forces (python rung on CPU), "off" disables. The same
+    # path re-runs at validator-set rotation (Node.rewarm_point_caches).
+    crypto_plane_warmup: str = "auto"
     # signature-decode rung (ISSUE 5): "device" batches compressed-point
     # decompression into the flush programs (ops/decompress.py),
     # "python" keeps the host bigint decode, "auto" resolves to device
@@ -120,6 +127,47 @@ class Node:
     sigagg: SigAgg | None = None
     crypto_plane: object | None = None  # core.cryptoplane.SlotCoalescer
     inclusion: InclusionChecker | None = None
+
+    async def rewarm_point_caches(
+        self, pubkeys=(), messages=()
+    ) -> dict:
+        """Validator-set rotation hook (ISSUE 6): bulk-warm the point
+        caches for a new key/message set BEFORE the next slot's flush,
+        through the coalescer's sharded warm programs when a crypto
+        plane is installed (single-chip nodes fall back to the
+        BlsEngine bulk path, off the event loop). Idempotent: already-
+        cached keys are skipped, so calling this on every rotation
+        costs only the delta. Device failures mid-pass step the warm
+        down to the host rung (python lanes in the stats), never
+        exceptions."""
+        return await _warm_point_caches(
+            self.crypto_plane, self.metrics, pubkeys, messages
+        )
+
+
+async def _warm_point_caches(
+    crypto_plane, metrics: ClusterMetrics, pubkeys=(), messages=()
+) -> dict:
+    """The ONE warm dispatch both the startup lifecycle hook and
+    Node.rewarm_point_caches ride: coalescer warm programs when a plane
+    is installed (it fires its own warmup_hook), else the BlsEngine
+    bulk path off the event loop with metrics recorded here."""
+    if crypto_plane is not None and hasattr(crypto_plane, "warm_caches"):
+        return await crypto_plane.warm_caches(
+            pubkeys=pubkeys, messages=messages
+        )
+    import asyncio as _asyncio
+
+    from charon_tpu.tbls import tpu_impl
+
+    stats = await _asyncio.get_running_loop().run_in_executor(
+        None,
+        lambda: tpu_impl.warm_point_caches(
+            pubkeys=list(pubkeys), messages=list(messages)
+        ),
+    )
+    metrics.observe_warmup(stats)
+    return stats
 
 
 def _resilient_ladder(primary):
@@ -319,6 +367,9 @@ async def build_node(config: Config) -> Node:
         crypto_plane.stats_hook = tracer.plane_span_bridge(
             node_tracer, inner_hook=_plane_stats
         )
+        # bulk warm-up passes (startup + rotation) land in the
+        # cold-start metric families (ISSUE 6)
+        crypto_plane.warmup_hook = metrics.observe_warmup
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -779,6 +830,63 @@ async def build_node(config: Config) -> Node:
             crypto_plane.close()
 
         life.register_stop(Order.SCHEDULER, "crypto-plane", stop_plane)
+
+    if config.use_tpu_tbls:
+        # bulk point-cache warm-up (ISSUE 6): decode the whole cluster
+        # key set through the batched device kernels at startup so the
+        # first live slot never pays the python-bigint cold burst
+        warmup = config.crypto_plane_warmup
+        if warmup == "auto":
+            # the canonical backend probe (not default_backend() ==
+            # "tpu"): plugin/tunneled TPUs report other platform names,
+            # and the decode rung + warm_point_caches auto both resolve
+            # through the same helper — the gates must agree
+            from charon_tpu.ops import limb as _limb
+
+            warmup = "on" if _limb._is_tpu_backend() else "off"
+        if warmup == "on":
+            warm_keyset = sorted(
+                {
+                    bytes.fromhex(v.distributed_public_key[2:])
+                    for v in lock.validators
+                }
+                | {
+                    bytes.fromhex(ps[2:])
+                    for v in lock.validators
+                    for ps in v.public_shares
+                }
+            )
+
+            async def warm_point_caches_start():
+                import time as _t
+
+                t0 = _t.monotonic()
+                try:
+                    stats = await _warm_point_caches(
+                        crypto_plane, metrics, pubkeys=warm_keyset
+                    )
+                except Exception as e:  # noqa: BLE001 — background task:
+                    # a failed warm-up must log (the operator otherwise
+                    # believes the caches are warm) but never block boot;
+                    # cold keys decode on demand exactly as before
+                    log.warn(
+                        "point-cache warm-up failed; first live slot "
+                        "decodes cold",
+                        topic="app",
+                        err=f"{type(e).__name__}: {str(e)[:160]}",
+                        seconds=round(_t.monotonic() - t0, 1),
+                    )
+                    return
+                log.info(
+                    "point caches warmed",
+                    topic="app",
+                    pubkeys=stats.get("pubkey"),
+                    seconds=round(_t.monotonic() - t0, 1),
+                )
+
+            life.register_start(
+                Order.MONITORING, "crypto-cache-warmup", warm_point_caches_start
+            )
 
     # health: the reference catalogue evaluated over this node's own
     # sampled metrics, gating /readyz (ref: app/health + monitoringapi)

@@ -169,6 +169,16 @@ def build_parser() -> argparse.ArgumentParser:
         "backends only (docs/operations.md 'Crypto-plane tuning')",
     )
     runp.add_argument(
+        "--crypto-plane-warmup",
+        choices=["auto", "on", "off"],
+        default=_env_default("crypto-plane-warmup", "") or "auto",
+        help="bulk point-cache warm-up at startup: decode the whole "
+        "cluster key set through the batched device kernels so the "
+        "first live slot starts warm; auto warms only on a TPU "
+        "backend (docs/operations.md 'Cold start and rotation "
+        "warm-up')",
+    )
+    runp.add_argument(
         "--relay",
         default=_env_default("relay", ""),
         help="host:port of a charon-tpu relay for NAT fallback dials",
@@ -487,6 +497,13 @@ def cmd_run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.crypto_plane_warmup not in ("auto", "on", "off"):
+        print(
+            f"--crypto-plane-warmup {args.crypto_plane_warmup!r}: "
+            "must be auto, on, or off",
+            file=sys.stderr,
+        )
+        return 2
 
     rc = _init_featureset(args)
     if rc:
@@ -528,6 +545,7 @@ def cmd_run(args) -> int:
         crypto_plane_decode_workers=args.crypto_plane_decode_workers,
         crypto_plane_prewarm=args.crypto_plane_prewarm,
         crypto_plane_decode=args.crypto_plane_decode,
+        crypto_plane_warmup=args.crypto_plane_warmup,
         tracing_endpoint=args.tracing_endpoint,
         tracing_jsonl=args.tracing_jsonl,
         relay_addr=args.relay,

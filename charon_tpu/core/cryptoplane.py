@@ -318,6 +318,12 @@ class SlotCoalescer:
         # the tracer bridge and bench_hostplane.py both read per-flush
         # spans from here instead of a coalescer-global trace list.
         self.stats_hook = stats_hook
+        # bulk warm-up observability (ISSUE 6): called with the stats
+        # dict of every warm_caches() pass (worker thread — thread-safe
+        # sinks only); counters for the /metrics families
+        self.warmup_hook = None
+        self.warmups = 0
+        self.warmup_lanes = 0
 
     @property
     def t(self) -> int:
@@ -1214,6 +1220,105 @@ class SlotCoalescer:
                 **kwargs,
             ),
         )
+
+    # -- bulk cache warm-up (ISSUE 6) --------------------------------------
+
+    def _plane_has_warm_api(self) -> bool:
+        return all(
+            hasattr(self.plane, name)
+            for name in ("hash_to_g2_host", "decompress_g1_host")
+        )
+
+    def _warm_sync(
+        self, pubkeys: list, messages: list, chunk: int | None
+    ) -> dict:
+        """Worker-thread body of warm_caches: bulk-decode through the
+        plane's sharded warm programs (device rung) or per-point host
+        decode (python rung / jax-less host), feeding the tpu_impl
+        point caches via PointCache.put."""
+        try:
+            from charon_tpu.tbls import tpu_impl
+        except Exception:  # pragma: no cover — jax-less host without
+            # the tbls device backend: there are no point caches to
+            # warm; report the skip instead of failing startup
+            return {
+                "pubkey": {"skipped": len(pubkeys)},
+                "message": {"skipped": len(messages)},
+                "seconds": 0.0,
+            }
+        device = (
+            self._decode_rung() == "device" and self._plane_has_warm_api()
+        )
+        plane = self.plane
+
+        class _PlaneWarmEngine:
+            """Adapter: the plane's sharded warm programs behind the
+            BlsEngine bulk-decode surface warm_point_caches drives."""
+
+            @staticmethod
+            def decompress_g1_batch(batch, subgroup_check=True):
+                return plane.decompress_g1_host(batch)
+
+            @staticmethod
+            def hash_to_g2_batch(batch):
+                return plane.hash_to_g2_host(batch)
+
+        return tpu_impl.warm_point_caches(
+            pubkeys=pubkeys,
+            messages=messages,
+            engine=_PlaneWarmEngine() if device else None,
+            device=device,
+            # None = inherit tpu_impl.WARMUP_CHUNK — one default for
+            # every warm path, documented in docs/operations.md
+            chunk=chunk if chunk is not None else tpu_impl.WARMUP_CHUNK,
+        )
+
+    async def warm_caches(
+        self,
+        pubkeys: Sequence[bytes] = (),
+        messages: Sequence[bytes] = (),
+        chunk: int | None = None,
+    ) -> dict:
+        """Bulk-populate the point caches for a key/message set — the
+        startup and validator-set-rotation hook (ISSUE 6). On the
+        device decode rung the field work (G1 decompression with the
+        GLV subgroup check, hash-to-curve SSWU + isogeny + psi cofactor
+        clearing) runs as chunked sharded device programs; the python
+        rung decodes per point on host (still off the event loop).
+
+        Runs on its OWN short-lived worker thread, NEVER the serialized
+        device lane: a live flush racing a warm-up must not queue
+        behind thousands of warm lanes (device dispatches interleave in
+        XLA's stream; host stages run in parallel). Idempotent — keys
+        already cached are skipped — so a rotation re-warm costs only
+        the new entries. Returns the per-cache stats dict and feeds it
+        to `warmup_hook`."""
+        import concurrent.futures
+
+        loop = asyncio.get_running_loop()
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="crypto-warmup"
+        )
+        try:
+            stats = await loop.run_in_executor(
+                ex,
+                self._warm_sync,
+                list(pubkeys),
+                list(messages),
+                chunk,
+            )
+        finally:
+            ex.shutdown(wait=False)
+        self.warmups += 1
+        self.warmup_lanes += sum(
+            n
+            for cache in ("pubkey", "message")
+            for src, n in stats.get(cache, {}).items()
+            if src in ("device", "python")
+        )
+        if self.warmup_hook is not None:
+            self.warmup_hook(stats)
+        return stats
 
     # -- python-spec host fallback (worker thread) -------------------------
 

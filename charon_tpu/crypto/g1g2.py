@@ -169,10 +169,45 @@ def g2_in_subgroup(pt) -> bool:
 # psi = twist o Frobenius o untwist on the M-twist: the host oracle for
 # the device decompression kernel's fast subgroup check. On G2, psi acts
 # as multiplication by the BLS parameter x = -X_ABS mod r. These
-# constants are THE definition — charon_tpu/ops/decompress.py imports
-# them, so kernel and oracle can never drift apart.
+# constants are THE definition — charon_tpu/ops/decompress.py and the
+# SSWU kernels (charon_tpu/ops/sswu.py) import them, so kernel and
+# oracle can never drift apart.
 PSI_CX = fp2_inv(fp2_pow(XI, (P - 1) // 3))
 PSI_CY = fp2_inv(fp2_pow(XI, (P - 1) // 2))
+
+# psi^2 collapses to a LINEAR map (no conjugation): psi(psi(x)) =
+# cx * conj(cx) * x, and cx * conj(cx) = norm(cx) lands in Fp;
+# cy * conj(cy) == -1 exactly. So psi^2(x, y) = (PSI2_CX * x, -y) —
+# one Fp scale and a negation, which is what the device cofactor-
+# clearing graph uses. Asserted against double-psi at import below.
+PSI2_CX = (PSI_CX[0] * PSI_CX[0] + PSI_CX[1] * PSI_CX[1]) % P
+
+# G1 GLV endomorphism phi(x, y) = (BETA * x, y) with BETA a nontrivial
+# cube root of unity in Fp; on G1 phi acts as multiplication by
+# G1_LAMBDA = X_ABS^2 - 1 (a root of lambda^2 + lambda + 1 mod r, since
+# r = x^4 - x^2 + 1 for BLS curves). The 127-bit [lambda]P ladder
+# replaces the 255-bit [r]P one in the device G1 subgroup check
+# (ops/decompress.py imports these constants). Which of the two
+# nontrivial cube roots matches G1_LAMBDA is fixed by the import-time
+# assert below — drift between kernel and oracle is impossible.
+# (2^((P-1)/3) is the OTHER root, i.e. lambda^2's; hence the square.)
+G1_BETA = pow(2, 2 * (P - 1) // 3, P)
+G1_LAMBDA = X_ABS * X_ABS - 1
+
+
+def g1_phi(pt):
+    if pt is None:
+        return None
+    return (pt[0] * G1_BETA % P, pt[1])
+
+
+def g1_in_subgroup_phi(pt) -> bool:
+    """Subgroup test via phi(P) == [lambda]P — equivalent to
+    g1_in_subgroup for on-curve points, with a 127-bit ladder instead
+    of the 255-bit [r]P one. Cross-checked in tests/test_sswu.py."""
+    if pt is None:
+        return True
+    return g1_is_on_curve(pt) and g1_phi(pt) == g1_mul_raw(pt, G1_LAMBDA)
 
 
 def g2_psi(pt):
@@ -180,6 +215,14 @@ def g2_psi(pt):
         return None
     x, y = pt
     return (fp2_mul(fp2_conj(x), PSI_CX), fp2_mul(fp2_conj(y), PSI_CY))
+
+
+def g2_psi2(pt):
+    """psi applied twice, via the collapsed linear constants."""
+    if pt is None:
+        return None
+    x, y = pt
+    return (fp2_scalar(x, PSI2_CX), fp2_neg(y))
 
 
 def g2_in_subgroup_psi(pt) -> bool:
@@ -191,6 +234,26 @@ def g2_in_subgroup_psi(pt) -> bool:
     return g2_is_on_curve(pt) and g2_psi(pt) == g2_neg(
         g2_mul_raw(pt, X_ABS)
     )
+
+
+def g2_clear_cofactor_psi(pt):
+    """Fast G2 cofactor clearing (Budroni–Pintore 2017):
+
+        h_eff * P = [x^2 - x - 1]P + [x - 1]psi(P) + psi^2(2P)
+
+    with x the (negative) BLS parameter. Exactly equal to the RFC 9380
+    [h_eff]P ladder on EVERY point of E'(Fp2) — asserted at import by
+    crypto/h2c._selfcheck — but costs two 64-bit ladders instead of the
+    1253-bit h_eff one (~9x fewer point ops). The host oracle for the
+    device cofactor-clearing graph (ops/sswu.py)."""
+    if pt is None:
+        return None
+    x_p = g2_neg(g2_mul_raw(pt, X_ABS))  # [x]P (x negative)
+    psi_p = g2_psi(pt)
+    t = g2_neg(g2_mul_raw(g2_add(x_p, psi_p), X_ABS))  # [x^2]P + [x]psi(P)
+    t = g2_add(t, g2_neg(g2_add(x_p, psi_p)))  # -[x]P - psi(P)
+    t = g2_add(t, g2_neg(pt))  # - P
+    return g2_add(t, g2_psi2(g2_double(pt)))
 
 
 # ---------------------------------------------------------------------------
@@ -340,6 +403,31 @@ def g2_to_bytes(pt) -> bytes:
     out = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
     out[0] |= flags
     return bytes(out)
+
+
+def _endo_selfcheck() -> None:
+    """Import-time consistency of the single-sourced endomorphism
+    constants (the kernel families in ops/decompress.py and ops/sswu.py
+    import them from here — a drifted constant must fail THIS import,
+    not a device batch):
+
+      * phi(G1) == [G1_LAMBDA]G1 — the GLV pair actually corresponds
+        (BETA has two nontrivial choices; only one matches LAMBDA);
+      * psi^2 via the collapsed linear constants == psi applied twice;
+      * psi(G2) == [x]G2 — the subgroup-check identity on the generator.
+    """
+    if pow(G1_BETA, 3, P) != 1 or G1_BETA == 1:
+        raise AssertionError("G1_BETA is not a nontrivial cube root of unity")
+    if g1_phi(G1_GEN) != g1_mul_raw(G1_GEN, G1_LAMBDA):
+        raise AssertionError("G1 GLV constants inconsistent: phi != [lambda]")
+    probe = g2_double(G2_GEN)
+    if g2_psi2(probe) != g2_psi(g2_psi(probe)):
+        raise AssertionError("PSI2 constants inconsistent with double psi")
+    if g2_psi(G2_GEN) != g2_neg(g2_mul_raw(G2_GEN, X_ABS)):
+        raise AssertionError("psi does not act as [x] on G2")
+
+
+_endo_selfcheck()
 
 
 def g2_from_bytes(data: bytes, subgroup_check: bool = True):

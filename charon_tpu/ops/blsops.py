@@ -28,6 +28,7 @@ from charon_tpu.ops import decompress as DEC
 from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
+from charon_tpu.ops import sswu as SSWU
 from charon_tpu.ops.limb import ModCtx
 
 
@@ -265,6 +266,18 @@ def _decompress_g1_kernel(ctx: ModCtx, fr_ctx: ModCtx, subgroup: bool):
     return _jit_kernel(
         lambda x0, sign, inf, ok: DEC.decompress_g1_graph(
             ctx, fr_ctx, x0, sign, inf, ok, subgroup=subgroup
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _hash_to_g2_kernel(ctx: ModCtx, fr_ctx: ModCtx):
+    """Device hash-to-curve tail (ISSUE 6): SSWU + 3-isogeny + psi
+    cofactor clearing in ONE program — the host ships only the cheap
+    SHA-256 hash_to_field outputs (ops/sswu.py)."""
+    return _jit_kernel(
+        lambda u00, u01, u10, u11, s0, s1: SSWU.hash_to_g2_graph(
+            ctx, fr_ctx, (u00, u01), (u10, u11), s0, s1
         )
     )
 
@@ -547,6 +560,31 @@ class BlsEngine:
             self.ctx, self.fr_ctx, subgroup_check
         )(*arrays)
         pts = C.g1_unpack(self.ctx, aff)[:n]
+        return pts, [bool(b) for b in np.asarray(valid)[:n]]
+
+    # -- batched hash-to-curve -------------------------------------------
+
+    def hash_to_g2_batch(self, msgs, dst: bytes = SSWU.DST_POP):
+        """Messages (raw bytes, or pre-hashed sswu.HashedMsg lanes) ->
+        ([affine G2 point], [valid]) with the field work (SSWU +
+        isogeny + psi cofactor clearing) batched on device; the host
+        pays only expand_message_xmd/hash_to_field (SHA-256). The bulk
+        cache warm-up path (ISSUE 6): a restart replays its message
+        set through here instead of per-point python hash_to_curve.
+        valid is always True for real lanes — carried per-lane so a
+        degraded batch masks instead of raising."""
+        lanes = [
+            m if isinstance(m, SSWU.HashedMsg) else SSWU.hash_to_field_lane(m, dst)
+            for m in msgs
+        ]
+        n = len(lanes)
+        if n == 0:
+            return [], []
+        pad = bucket_lanes(n)
+        lanes = lanes + [lanes[0]] * (pad - n)
+        arrays = SSWU.pack_hashed(self.ctx, lanes)
+        aff, valid = _hash_to_g2_kernel(self.ctx, self.fr_ctx)(*arrays)
+        pts = C.g2_unpack(self.ctx, aff)[:n]
         return pts, [bool(b) for b in np.asarray(valid)[:n]]
 
     # -- scalar multiplication (DKG / key derivation) --------------------

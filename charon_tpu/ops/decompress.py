@@ -25,8 +25,11 @@ engine splits work (SURVEY §7):
       - G2 subgroup membership by the psi endomorphism: P is in G2 iff
         psi(P) == [x]P with x the (negative) BLS parameter — a 64-bit
         ladder instead of the 255-bit [r]P ladder (Scott 2021, "A note
-        on group membership tests"; host oracle: g1g2.g2_psi). G1 keeps
-        the [r]P ladder (the pubkey path is cache-hit dominated).
+        on group membership tests"; host oracle: g1g2.g2_psi). G1 uses
+        the GLV twin: P is in G1 iff phi(P) == [lambda]P with
+        phi(x, y) = (beta*x, y) — a 127-bit ladder (ISSUE 6: the [r]P
+        ladder it replaced was the bulk-warm-up bottleneck; host
+        oracle: g1g2.g1_in_subgroup_phi).
 
     Malformed encodings NEVER raise: every lane carries a validity bit
     from host parse through the device mask, so one forged signature in
@@ -64,12 +67,19 @@ ROOTS_OF_UNITY = (
 )
 ROOTS_OF_UNITY_SQ = tuple(F.fp2_sqr(r) for r in ROOTS_OF_UNITY)
 
-# -- psi endomorphism (untwist-Frobenius-twist) on the M-twist --------------
+# -- endomorphism constants (single-sourced in the host oracle) -------------
 # psi(x, y) = (cx * conj(x), cy * conj(y)); on G2 psi acts as
-# multiplication by the BLS parameter x = -X_ABS (mod r). The constants
-# are imported from the host oracle (g1g2.g2_psi, jax-free) — one
-# definition, so kernel and oracle cannot drift.
-from charon_tpu.crypto.g1g2 import PSI_CX, PSI_CY  # noqa: E402
+# multiplication by the BLS parameter x = -X_ABS (mod r). phi(x, y) =
+# (beta*x, y) on G1 acts as multiplication by lambda = x^2 - 1. All
+# constants are imported from the host oracle (g1g2.g2_psi / g1_phi,
+# jax-free, import-time consistency asserts there) — one definition,
+# so kernel and oracle cannot drift.
+from charon_tpu.crypto.g1g2 import (  # noqa: E402
+    G1_BETA,
+    G1_LAMBDA,
+    PSI_CX,
+    PSI_CY,
+)
 
 X_ABS = F.X_ABS
 
@@ -328,15 +338,47 @@ def decompress_g2_graph(
     return (x, y), valid | (infinity & host_ok)
 
 
+def g1_subgroup_phi_graph(ctx, fr_ctx, affine):
+    """P in G1 iff phi(P) == [lambda]P (Scott 2021) with phi(x, y) =
+    (beta*x, y) — a 127-bit ladder instead of the 255-bit [r]P one,
+    the GLV twin of the psi G2 check (host oracle:
+    g1g2.g1_in_subgroup_phi). Equality is checked by cross-multiplying
+    against the projective [lambda]P, so no extra inversion. Identity-
+    blanked lanes FAIL the compare (Y=1, y=0); callers AND this into a
+    mask that is already False there, and infinity lanes are re-ORed
+    after, so the verdict is unchanged."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as C
+    from charon_tpu.ops import limb
+
+    x, y = affine
+    f = C.g1_ops(ctx)
+    proj = C.affine_to_point(f, affine)
+    scal = jnp.asarray(
+        limb.int_to_limbs(
+            G1_LAMBDA, fr_ctx.n_limbs, fr_ctx.limb_bits, fr_ctx.np_dtype
+        )
+    )
+    lx, ly, lz = C.point_scalar_mul(
+        f, fr_ctx, proj, scal, nbits=G1_LAMBDA.bit_length()
+    )
+    beta = limb.const(ctx, G1_BETA, x.shape[:-1])
+    phi_x = limb.mont_mul(ctx, x, beta)
+    same_x = jnp.all(limb.mont_mul(ctx, phi_x, lz) == lx, axis=-1)
+    same_y = jnp.all(limb.mont_mul(ctx, y, lz) == ly, axis=-1)
+    return same_x & same_y
+
+
 def decompress_g1_graph(
     ctx, fr_ctx, x_raw, sign, infinity=None, host_ok=None, subgroup=True
 ):
     """Batched compressed-G1 field work (Fp chain, p = 3 mod 4). The
-    subgroup check keeps the [r]P ladder — the pubkey path is cache-hit
-    dominated, so simplicity beats the GLV shortcut here."""
+    subgroup check uses the GLV phi endomorphism (127-bit ladder) —
+    the [r]P ladder it replaces was the bulk-warmup bottleneck for a
+    1M-key cold start (ISSUE 6)."""
     import jax.numpy as jnp
 
-    from charon_tpu.ops import curve as C
     from charon_tpu.ops import limb
 
     shape = x_raw.shape[:-1]
@@ -358,18 +400,7 @@ def decompress_g1_graph(
     x = limb.select(valid, x, zero)
     y = limb.select(valid, y, zero)
     if subgroup:
-        f = C.g1_ops(ctx)
-        proj = C.affine_to_point(f, (x, y))
-        order = jnp.asarray(
-            limb.int_to_limbs(
-                fr_ctx.modulus,
-                fr_ctx.n_limbs,
-                fr_ctx.limb_bits,
-                fr_ctx.np_dtype,
-            )
-        )
-        rp = C.point_scalar_mul(f, fr_ctx, proj, order)
-        valid = valid & C.point_is_identity(f, rp)
+        valid = valid & g1_subgroup_phi_graph(ctx, fr_ctx, (x, y))
         x = limb.select(valid, x, zero)
         y = limb.select(valid, y, zero)
     return (x, y), valid | (infinity & host_ok)

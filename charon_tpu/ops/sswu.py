@@ -1,0 +1,332 @@
+"""Batched hash-to-curve (G2 SSWU) on the limb engine (ISSUE 6).
+
+The last pure-Python bigint burst on the COLD path was message
+hash-to-curve: a restart or validator-set rotation pays ~ms of host
+field arithmetic per uncached message (SSWU + 3-isogeny + cofactor
+clearing in crypto/h2c.py). This module splits it the same way
+ops/decompress.py split point decompression (SURVEY §7):
+
+  * HOST — `hash_to_field_lane`: expand_message_xmd + hash_to_field
+    (RFC 9380 §5.2/§5.3.1, SHA-256 and byte slicing only, no field
+    arithmetic, no jax import) -> two Fp2 elements per message plus
+    their sgn0 bits (u is host-known, so the RFC sign of y is decided
+    by a host bit instead of a device parity graph on u).
+  * DEVICE — `hash_to_g2_graph`: the field work, batched over lanes:
+      - simplified SWU onto E'' by a CONSTANT-TIME reformulation of
+        RFC 9380 §6.6.2: one fixed-exponent chain gx1^((p^2+7)/16)
+        (p^2 = 9 mod 16 — the same four-4th-roots-of-unity correction
+        machinery as the decompression kernels) serves BOTH branches:
+        the four candidates c*r decide the square case, and the
+        non-square case's sqrt(gx2) = u^3 * Z^(3(p^2+7)/16) * c * r
+        reuses c with a host-precomputed constant, so no second chain;
+      - the 3-isogeny E'' -> E' (Horner over the RFC appendix E.3
+        constants, both denominators inverted through ONE shared
+        Fermat chain);
+      - cofactor clearing by the psi-endomorphism split
+        (Budroni–Pintore): h_eff*P = [x^2-x-1]P + [x-1]psi(P) +
+        psi^2(2P) — two 64-bit ladders instead of the 1253-bit h_eff
+        one. Host oracle: g1g2.g2_clear_cofactor_psi (asserted equal
+        to the spec [h_eff]P ladder at import of crypto/h2c).
+
+    Per-lane `ok` masks ride the whole graph (mathematically always
+    True — SSWU is total — but carried so a malformed/padded lane can
+    NEVER raise; the bulk warm-up path depends on that contract).
+
+Endomorphism constants (PSI_CX/PSI_CY/PSI2_CX) are imported from the
+host oracle in crypto/g1g2 — one definition, kernel and oracle cannot
+drift (import-time asserts live there). Host constants below are pure
+ints via crypto/fields, so importing this module never touches jax —
+the graph functions import the limb engine lazily (bench_hostplane
+times the host half without a device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.crypto import h2c as H
+from charon_tpu.crypto.g1g2 import PSI2_CX
+from charon_tpu.ops.decompress import (
+    ROOTS_OF_UNITY,
+    ROOTS_OF_UNITY_SQ,
+    SQRT_EXP_G2,
+    fp2_pow_const,
+    g2_psi_graph,
+)
+
+P = F.P
+X_ABS = F.X_ABS
+
+DST_POP = H.DST_POP
+
+# -- host-precomputed SSWU constants (pure ints) ----------------------------
+_A, _B, _Z = H.A_PRIME, H.B_PRIME, H.Z_SSWU
+# generic-branch x1 = (-B/A) * (1 + 1/(Z u^2 + Z^2 u^4)); exceptional
+# (denominator == 0) x1 = B / (Z A)
+NEG_B_OVER_A = F.fp2_mul(F.fp2_neg(_B), F.fp2_inv(_A))
+B_OVER_ZA = F.fp2_mul(_B, F.fp2_inv(F.fp2_mul(_Z, _A)))
+# Z^(3(p^2+7)/16): with c = gx1^((p^2+7)/16) already computed for the
+# square branch, sqrt(gx2) = sqrt(gx1 * (Z u^2)^3) = u^3 * C_Z3 * c
+# up to a 4th root of unity — the non-square branch costs four
+# multiply+compare corrections instead of a second 758-bit chain.
+C_Z3 = F.fp2_pow(_Z, 3 * (P * P + 7) // 16)
+
+
+# ---------------------------------------------------------------------------
+# Host hashing (jax-free)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HashedMsg:
+    """One message after host hash_to_field: the two Fp2 elements of
+    the RO construction plus their sgn0 bits."""
+
+    u0: tuple
+    u1: tuple
+    sgn0: bool
+    sgn1: bool
+
+
+def hash_to_field_lane(msg: bytes, dst: bytes = DST_POP) -> HashedMsg:
+    """RFC 9380 hash_to_field for one message — SHA-256 + byte work
+    only; the microseconds-per-lane host half of the device path."""
+    u0, u1 = H.hash_to_field_fp2(msg, 2, dst)
+    return HashedMsg(u0, u1, bool(F.fp2_sgn0(u0)), bool(F.fp2_sgn0(u1)))
+
+
+def pack_hashed(ctx, lanes):
+    """[HashedMsg] -> device inputs: four raw limb arrays (u0/u1 Fp2
+    components) + two sgn0 bool arrays. Numpy/jnp packing only."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from charon_tpu.ops import limb
+
+    u00 = jnp.asarray(limb.ctx_pack(ctx, [l.u0[0] for l in lanes]))
+    u01 = jnp.asarray(limb.ctx_pack(ctx, [l.u0[1] for l in lanes]))
+    u10 = jnp.asarray(limb.ctx_pack(ctx, [l.u1[0] for l in lanes]))
+    u11 = jnp.asarray(limb.ctx_pack(ctx, [l.u1[1] for l in lanes]))
+    s0 = jnp.asarray(np.asarray([l.sgn0 for l in lanes], bool))
+    s1 = jnp.asarray(np.asarray([l.sgn1 for l in lanes], bool))
+    return u00, u01, u10, u11, s0, s1
+
+
+# ---------------------------------------------------------------------------
+# Device graph pieces (composable inside any jitted program)
+# ---------------------------------------------------------------------------
+
+
+def fp2_sgn0_graph(ctx, a):
+    """RFC 9380 sgn0 for a Montgomery Fp2 element, as a device bool:
+    sign_0 | (zero_0 & sign_1) on the raw (non-Montgomery) limbs.
+    Limb 0 carries the low bits (little-endian, even limb width), so
+    parity is bit 0 of limb 0."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import limb
+
+    a0r = limb.from_mont(ctx, a[0])
+    a1r = limb.from_mont(ctx, a[1])
+    sign_0 = (a0r[..., 0] & ctx.u(1)) != 0
+    sign_1 = (a1r[..., 0] & ctx.u(1)) != 0
+    return sign_0 | (limb.is_zero(a0r) & sign_1)
+
+
+def sswu_graph(ctx, u, sgn_u):
+    """Simplified SWU onto E'' (RFC 9380 §6.6.2), branch-free.
+
+    u: Montgomery Fp2 (pair of (..., L) arrays); sgn_u: host sgn0(u)
+    bools. Returns ((x, y) affine on E'', ok). `ok` is True whenever
+    one of the eight sqrt candidates verified — always, for real field
+    elements — and rides the caller's validity mask so a bad lane can
+    never raise."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import fptower as T
+
+    shape = u[0].shape[:-1]
+    u2 = T.fp2_sqr(ctx, u)
+    tv1 = T.fp2_mul(ctx, u2, T.fp2_const(ctx, _Z, shape))  # Z u^2
+    tv2 = T.fp2_sqr(ctx, tv1)
+    den = T.fp2_add(ctx, tv1, tv2)
+    den_zero = T.fp2_is_zero(den)
+    # fp2_inv(0) == 0, so the generic expression is garbage-free on the
+    # exceptional lanes and the select swaps in B/(Z A)
+    x1 = T.fp2_mul(
+        ctx,
+        T.fp2_const(ctx, NEG_B_OVER_A, shape),
+        T.fp2_add(ctx, T.fp2_one(ctx, shape), T.fp2_inv(ctx, den)),
+    )
+    x1 = T.fp2_select(den_zero, T.fp2_const(ctx, B_OVER_ZA, shape), x1)
+    a_const = T.fp2_const(ctx, _A, shape)
+    b_const = T.fp2_const(ctx, _B, shape)
+    gx1 = T.fp2_add(
+        ctx,
+        T.fp2_mul(ctx, T.fp2_add(ctx, T.fp2_sqr(ctx, x1), a_const), x1),
+        b_const,
+    )
+    # THE chain: c = gx1^((p^2+7)/16); everything else is corrections
+    c = fp2_pow_const(ctx, gx1, SQRT_EXP_G2)
+    c2 = T.fp2_sqr(ctx, c)
+    y = T.fp2_zero(ctx, shape)
+    ok1 = jnp.zeros(shape, bool)
+    for r, r2 in zip(ROOTS_OF_UNITY, ROOTS_OF_UNITY_SQ):
+        match = T.fp2_eq(
+            T.fp2_mul(ctx, c2, T.fp2_const(ctx, r2, shape)), gx1
+        )
+        cand = T.fp2_mul(ctx, c, T.fp2_const(ctx, r, shape))
+        y = T.fp2_select(match & ~ok1, cand, y)
+        ok1 = ok1 | match
+    # non-square branch: x2 = Z u^2 x1, gx2 = gx1 (Z u^2)^3, and
+    # sqrt(gx2) = u^3 * C_Z3 * c up to the same four roots
+    x2 = T.fp2_mul(ctx, tv1, x1)
+    gx2 = T.fp2_mul(ctx, gx1, T.fp2_mul(ctx, tv1, tv2))
+    u3 = T.fp2_mul(ctx, u2, u)
+    base = T.fp2_mul(
+        ctx, T.fp2_mul(ctx, u3, c), T.fp2_const(ctx, C_Z3, shape)
+    )
+    base2 = T.fp2_sqr(ctx, base)
+    y2 = T.fp2_zero(ctx, shape)
+    ok2 = jnp.zeros(shape, bool)
+    for r, r2 in zip(ROOTS_OF_UNITY, ROOTS_OF_UNITY_SQ):
+        match = T.fp2_eq(
+            T.fp2_mul(ctx, base2, T.fp2_const(ctx, r2, shape)), gx2
+        )
+        cand = T.fp2_mul(ctx, base, T.fp2_const(ctx, r, shape))
+        y2 = T.fp2_select(match & ~ok2, cand, y2)
+        ok2 = ok2 | match
+    x = T.fp2_select(ok1, x1, x2)
+    y = T.fp2_select(ok1, y, y2)
+    # RFC sign: sgn0(y) must equal sgn0(u)
+    flip = fp2_sgn0_graph(ctx, y) != sgn_u
+    y = T.fp2_select(flip, T.fp2_neg(ctx, y), y)
+    return (x, y), ok1 | ok2
+
+
+def iso_map_graph(ctx, pt):
+    """3-isogeny E'' -> E' (RFC 9380 appendix E.3) on batched affine
+    points. Both denominators share ONE Fermat inversion chain via the
+    product trick: inv(xd) = inv(xd yd) yd, inv(yd) = inv(xd yd) xd."""
+    from charon_tpu.ops import fptower as T
+
+    x, y = pt
+    shape = x[0].shape[:-1]
+
+    def horner(coeffs):
+        acc = T.fp2_const(ctx, coeffs[-1], shape)
+        for k in reversed(coeffs[:-1]):
+            acc = T.fp2_add(
+                ctx, T.fp2_mul(ctx, acc, x), T.fp2_const(ctx, k, shape)
+            )
+        return acc
+
+    x_num = horner(H._K["x_num"])
+    x_den = horner(H._K["x_den"])
+    y_num = horner(H._K["y_num"])
+    y_den = horner(H._K["y_den"])
+    d_inv = T.fp2_inv(ctx, T.fp2_mul(ctx, x_den, y_den))
+    xo = T.fp2_mul(ctx, x_num, T.fp2_mul(ctx, d_inv, y_den))
+    yo = T.fp2_mul(
+        ctx, y, T.fp2_mul(ctx, y_num, T.fp2_mul(ctx, d_inv, x_den))
+    )
+    return (xo, yo)
+
+
+def _g2_psi_proj(ctx, p):
+    """psi on batched PROJECTIVE G2: conjugate all coordinates, scale
+    X by cx and Y by cy (homogeneous, so Z just conjugates)."""
+    from charon_tpu.ops import fptower as T
+
+    x, y, z = p
+    psi_aff = g2_psi_graph(ctx, (x, y))
+    return (psi_aff[0], psi_aff[1], T.fp2_conj(ctx, z))
+
+
+def _g2_psi2_proj(ctx, p):
+    """psi^2 as its collapsed LINEAR form: (PSI2_CX * X, -Y, Z) — one
+    Fp scale and a negation (constants single-sourced in g1g2)."""
+    from charon_tpu.ops import fptower as T
+    from charon_tpu.ops import limb
+
+    x, y, z = p
+    shape = x[0].shape[:-1]
+    cx = limb.const(ctx, PSI2_CX, shape)
+    return (T.fp2_mul_fp(ctx, x, cx), T.fp2_neg(ctx, y), z)
+
+
+def _ladder_x(ctx, fr_ctx, f, p):
+    """[x]P for the (negative) BLS parameter: a 64-bit |x| ladder plus
+    a negation."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as C
+    from charon_tpu.ops import limb
+
+    scal = jnp.asarray(
+        limb.int_to_limbs(
+            X_ABS, fr_ctx.n_limbs, fr_ctx.limb_bits, fr_ctx.np_dtype
+        )
+    )
+    return C.point_neg(
+        f, C.point_scalar_mul(f, fr_ctx, p, scal, nbits=X_ABS.bit_length())
+    )
+
+
+def clear_cofactor_psi_graph(ctx, fr_ctx, proj):
+    """Budroni–Pintore cofactor clearing on batched projective G2:
+    [x^2-x-1]P + [x-1]psi(P) + psi^2(2P). Two 64-bit ladders + a
+    handful of complete adds — vs 1253 doublings for the h_eff ladder.
+    Oracle: g1g2.g2_clear_cofactor_psi."""
+    from charon_tpu.ops import curve as C
+
+    f = C.g2_ops(ctx)
+    x_p = _ladder_x(ctx, fr_ctx, f, proj)  # [x]P
+    psi_p = _g2_psi_proj(ctx, proj)
+    s = C.point_add(f, x_p, psi_p)  # [x]P + psi(P)
+    t = _ladder_x(ctx, fr_ctx, f, s)  # [x^2]P + [x]psi(P)
+    t = C.point_add(f, t, C.point_neg(f, s))  # ... - [x]P - psi(P)
+    t = C.point_add(f, t, C.point_neg(f, proj))  # ... - P
+    two_p = C.point_double(f, proj)
+    return C.point_add(f, t, _g2_psi2_proj(ctx, two_p))
+
+
+def map_to_g2_graph(ctx, u, sgn_u):
+    """SSWU + isogeny: one hash_to_field output -> affine E' point."""
+    q, ok = sswu_graph(ctx, u, sgn_u)
+    return iso_map_graph(ctx, q), ok
+
+
+def hash_to_g2_graph(ctx, fr_ctx, u0_raw, u1_raw, sgn0, sgn1, host_ok=None):
+    """Full device hash_to_curve tail: two raw-limb Fp2 elements (the
+    host hash_to_field outputs, pairs of (..., L) arrays) + sgn0 bits
+    -> ((x, y) Montgomery affine G2 in the r-subgroup, valid).
+
+    Invalid/padded lanes (host_ok False, or the mathematically-
+    impossible no-root case) come out as the (0, 0) affine identity
+    encoding with valid False — never exceptions."""
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import curve as C
+    from charon_tpu.ops import fptower as T
+    from charon_tpu.ops import limb
+
+    shape = u0_raw[0].shape[:-1]
+    if host_ok is None:
+        host_ok = jnp.ones(shape, bool)
+    u0 = (limb.to_mont(ctx, u0_raw[0]), limb.to_mont(ctx, u0_raw[1]))
+    u1 = (limb.to_mont(ctx, u1_raw[0]), limb.to_mont(ctx, u1_raw[1]))
+    q0, ok0 = map_to_g2_graph(ctx, u0, sgn0)
+    q1, ok1 = map_to_g2_graph(ctx, u1, sgn1)
+    f = C.g2_ops(ctx)
+    p = C.point_add(
+        f, C.affine_to_point(f, q0), C.affine_to_point(f, q1)
+    )
+    p = clear_cofactor_psi_graph(ctx, fr_ctx, p)
+    x, y = C.point_to_affine(f, p)
+    valid = ok0 & ok1 & host_ok
+    zero = T.fp2_zero(ctx, shape)
+    x = T.fp2_select(valid, x, zero)
+    y = T.fp2_select(valid, y, zero)
+    return (x, y), valid

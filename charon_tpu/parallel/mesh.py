@@ -33,6 +33,7 @@ from charon_tpu.ops import decompress as DEC
 from charon_tpu.ops import fptower as T
 from charon_tpu.ops import limb
 from charon_tpu.ops import pairing as DP
+from charon_tpu.ops import sswu as SSWU
 from charon_tpu.ops.limb import ModCtx
 
 
@@ -117,6 +118,11 @@ class SlotCryptoPlane:
         self._verify_rlc_dec = self._build_verify_rlc_dec()
         self._step_dec = self._build_dec()
         self._step_rlc_dec = self._build_rlc_dec()
+        # bulk warm-up programs (ISSUE 6): sharded hash-to-curve and G1
+        # decompression for the cold-path cache warm — one compiled
+        # program feeds thousands of point-cache entries per dispatch.
+        self._h2c = self._build_h2c()
+        self._g1dec = self._build_g1dec()
 
     def _step_body(self, pubshares, msg, partials, group_pk, indices, live):
         """Per-shard recombine + per-lane attribution verify. Shared by
@@ -359,6 +365,48 @@ class SlotCryptoPlane:
         )
         return jax.jit(sharded)
 
+    def _build_h2c(self):
+        """Sharded device hash-to-curve tail: hash_to_field outputs in,
+        cleared G2 points out (ops/sswu.hash_to_g2_graph). The bulk
+        message-cache warm-up program."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local(u00, u01, u10, u11, s0, s1, live):
+            aff, valid = SSWU.hash_to_g2_graph(
+                ctx, fr_ctx, (u00, u01), (u10, u11), s0, s1
+            )
+            return aff, jnp.logical_and(valid, live)
+
+        sharded = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                P(axis),
+            ),
+            out_specs=(P(axis), P(axis)),
+        )
+        return jax.jit(sharded)
+
+    def _build_g1dec(self):
+        """Sharded batched G1 decompression (GLV subgroup check) — the
+        bulk pubkey-cache warm-up program."""
+        ctx, fr_ctx, axis = self.ctx, self.fr_ctx, self.axis
+
+        def local(x0, sign, inf, ok, live):
+            aff, valid = DEC.decompress_g1_graph(
+                ctx, fr_ctx, x0, sign, inf, ok
+            )
+            return aff, jnp.logical_and(valid, live)
+
+        sharded = _shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis)),
+        )
+        return jax.jit(sharded)
+
     def _build_verify(self):
         """Plain per-lane sharded verify: ok[N] — the attribution path
         (each lane pays its own final exponentiation; used only when the
@@ -460,7 +508,56 @@ class SlotCryptoPlane:
                 self._step_rlc_dec,
                 self._verify_dec,
                 self._verify_rlc_dec,
+                self._h2c,
+                self._g1dec,
             )
+        )
+
+    # -- bulk warm-up host API (ISSUE 6) ----------------------------------
+
+    def hash_to_g2_host(self, msgs, dst: bytes = SSWU.DST_POP):
+        """Messages (raw bytes or sswu.HashedMsg lanes) -> ([affine G2
+        point], [valid]) through the sharded device SSWU program; the
+        host pays only SHA-256 hash_to_field. Bucket-padded like every
+        other entry point, so warm-up chunks reuse compiled programs."""
+        lanes = [
+            m
+            if isinstance(m, SSWU.HashedMsg)
+            else SSWU.hash_to_field_lane(m, dst)
+            for m in msgs
+        ]
+        n = len(lanes)
+        if n == 0:
+            return [], []
+        pad = self.bucket_lanes(n) - n
+        lanes = lanes + [lanes[0]] * pad
+        arrays = SSWU.pack_hashed(self.ctx, lanes)
+        live = jnp.asarray(np.arange(n + pad) < n)
+        aff, valid = self._h2c(*arrays, live)
+        return (
+            C.g2_unpack(self.ctx, aff)[:n],
+            [bool(b) for b in np.asarray(valid)[:n]],
+        )
+
+    def decompress_g1_host(self, encoded):
+        """Compressed 48-byte G1 lanes (or parsed lanes) -> ([affine
+        point | None], [valid]) through the sharded decompression
+        program — per-lane masks, never exceptions."""
+        parsed = [
+            p if isinstance(p, DEC.ParsedPoint) else DEC.parse_g1_lane(p)
+            for p in encoded
+        ]
+        n = len(parsed)
+        if n == 0:
+            return [], []
+        pad = self.bucket_lanes(n) - n
+        parsed = parsed + [parsed[0]] * pad
+        x0, sign, inf, ok = DEC.pack_parsed_g1(self.ctx, parsed)
+        live = jnp.asarray(np.arange(n + pad) < n)
+        aff, valid = self._g1dec(x0, sign, inf, ok, live)
+        return (
+            C.g1_unpack(self.ctx, aff)[:n],
+            [bool(b) for b in np.asarray(valid)[:n]],
         )
 
     def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):

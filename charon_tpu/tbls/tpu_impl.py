@@ -20,8 +20,10 @@ as are hashed messages.
 
 from __future__ import annotations
 
+import collections
 import functools
-from typing import Mapping, Sequence
+import threading
+from typing import Mapping, NamedTuple, Sequence
 
 from charon_tpu.crypto import g1g2, h2c
 from charon_tpu.crypto.fields import R
@@ -47,21 +49,197 @@ def _decode_msg_point(data: bytes):
     return h2c.hash_to_g2(data)
 
 
-def make_point_cache(decode, maxsize: int):
+class _CacheInfo(NamedTuple):
+    hits: int
+    misses: int
+    maxsize: int
+    currsize: int
+
+
+class PointCache:
+    """Thread-safe LRU point cache with BULK insertion (ISSUE 6).
+
+    functools.lru_cache almost fits, but it cannot be pre-populated —
+    and the whole point of the warm-up path is to decode a restart's
+    key/message set through ONE device program and insert the results,
+    so the first live slot starts at a ~100% hit rate instead of
+    paying a python-bigint burst. Mirrors the lru_cache surface the
+    metrics/test plumbing reads (cache_info / cache_clear) plus put()
+    and __contains__ for the bulk path. Decode runs OUTSIDE the lock:
+    the caches are hammered from the coalescer's decode pool, so
+    concurrent misses of the same key may decode twice (same contract
+    as lru_cache) but never block each other for milliseconds."""
+
+    def __init__(self, decode, maxsize: int):
+        self._decode = decode
+        self._maxsize = maxsize
+        self._data: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    def __call__(self, key):
+        with self._lock:
+            try:
+                val = self._data[key]
+            except KeyError:
+                self._misses += 1
+            else:
+                self._data.move_to_end(key)
+                self._hits += 1
+                return val
+        val = self._decode(key)  # bigint work — never under the lock
+        self.put(key, val)
+        return val
+
+    def put(self, key, value) -> None:
+        """Insert without decoding — the bulk warm-up entry point."""
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self._maxsize:
+                self._data.popitem(last=False)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def cache_info(self) -> _CacheInfo:
+        with self._lock:
+            return _CacheInfo(
+                self._hits, self._misses, self._maxsize, len(self._data)
+            )
+
+    def cache_clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._hits = 0
+            self._misses = 0
+
+
+def make_point_cache(decode, maxsize: int) -> PointCache:
     """LRU-wrap a point decoder. The module-level caches below use the
     production capacities; tests build small-capacity instances of the
-    SAME wrapper to pin hit/eviction/concurrency behavior (the caches
-    are hammered from the coalescer's decode pool, so the thread-safety
-    of functools.lru_cache is load-bearing)."""
-    return functools.lru_cache(maxsize=maxsize)(decode)
+    SAME wrapper to pin hit/eviction/concurrency/bulk-put behavior
+    (the caches are hammered from the coalescer's decode pool, so
+    PointCache's thread-safety is load-bearing)."""
+    return PointCache(decode, maxsize)
 
 
 # Decompressed pubkeys cached by compressed bytes (cluster pubshares are
 # a small static set — ref: core/validatorapi pubshare maps), as are
 # hashed messages. Shared by this impl AND core/cryptoplane's decode
-# pool.
+# pool, and bulk-fed by the warm-up path below.
 _cached_pubkey_point = make_point_cache(_decode_pubkey_point, 65536)
 _cached_msg_point = make_point_cache(_decode_msg_point, 16384)
+
+# Warm-up lanes per device program — THE default for every warm path
+# (SlotCoalescer.warm_caches inherits it; docs/operations.md documents
+# it): big enough to amortize dispatch, small enough that a warm chunk
+# never monopolizes the device for whole seconds.
+WARMUP_CHUNK = 512
+
+
+def warm_point_caches(
+    pubkeys: Sequence[bytes] = (),
+    messages: Sequence[bytes] = (),
+    engine: "blsops.BlsEngine | None" = None,
+    device: bool | None = None,
+    chunk: int = WARMUP_CHUNK,
+) -> dict:
+    """Bulk-populate the module point caches (ISSUE 6 cold path).
+
+    Pubkeys decode through `decompress_g1_batch` (GLV subgroup check)
+    and messages through `hash_to_g2_batch` (device SSWU + isogeny +
+    psi cofactor clearing) in `chunk`-sized device programs; the
+    python rung (`device=False`, or auto on a non-TPU backend) decodes
+    per point on host — still a valid warm-up, just the old cost.
+    Lanes the device marks invalid are NOT inserted: the on-demand
+    decode re-raises the precise error when (if ever) the key is used.
+
+    A device failure mid-pass (dead tunnel, XLA runtime error) steps
+    the REST of the pass down to the python rung instead of raising —
+    the PR 2 ladder discipline; warm-up can degrade but never aborts a
+    rotation, and the step-down is visible as python lanes in the
+    stats.
+
+    Returns per-cache stats: lanes by source (device/python/cached/
+    invalid) plus wall seconds — the shape app/metrics.observe_warmup
+    records."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    if device is None:
+        device = limb._is_tpu_backend()
+    eng = None
+    if device:
+        try:
+            eng = engine or blsops.default_engine()
+        except Exception:  # jax-less / broken backend: host rung
+            device = False
+    rung = {"device": device}
+    stats = {
+        "pubkey": {"device": 0, "python": 0, "cached": 0, "invalid": 0},
+        "message": {"device": 0, "python": 0, "cached": 0, "invalid": 0},
+    }
+
+    def work(keys, cache, bulk, single, name):
+        st = stats[name]
+        # lanes are UNIQUE keys: duplicates in the input collapse before
+        # accounting, so a cold start with a repeated key never reports
+        # source="cached" lanes it did not actually skip
+        uniq = list(dict.fromkeys(keys))
+        todo = [k for k in uniq if k not in cache]
+        st["cached"] += len(uniq) - len(todo)
+        cap = cache.cache_info().maxsize
+        if len(todo) > cap:
+            # decoding past capacity would only evict its own results:
+            # warm the LAST cap keys (insertion order keeps them alive)
+            # and report the rest as overflow — never burn device work
+            # on lanes that cannot survive, never report them "warmed"
+            st["overflow"] = st.get("overflow", 0) + len(todo) - cap
+            todo = todo[-cap:]
+        for i in range(0, len(todo), chunk):
+            batch = todo[i : i + chunk]
+            if rung["device"]:
+                try:
+                    pts, valid = bulk(batch)
+                except Exception:  # noqa: BLE001 — device rung failure
+                    # (dead tunnel / XLA error): step the rest of the
+                    # pass down to host decode, never raise out of a
+                    # warm-up
+                    rung["device"] = False
+                else:
+                    for k, pt, ok in zip(batch, pts, valid):
+                        if ok and pt is not None:
+                            cache.put(k, pt)
+                            st["device"] += 1
+                        else:
+                            st["invalid"] += 1
+                    continue
+            for k in batch:
+                try:
+                    cache.put(k, single(k))
+                    st["python"] += 1
+                except (TblsError, ValueError):
+                    st["invalid"] += 1
+
+    work(
+        pubkeys,
+        _cached_pubkey_point,
+        lambda b: eng.decompress_g1_batch(b, subgroup_check=True),
+        _decode_pubkey_point,
+        "pubkey",
+    )
+    work(
+        messages,
+        _cached_msg_point,
+        lambda b: eng.hash_to_g2_batch(b),
+        _decode_msg_point,
+        "message",
+    )
+    stats["seconds"] = _time.monotonic() - t0
+    return stats
 
 
 class TPUImpl(Implementation):

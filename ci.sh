@@ -36,20 +36,25 @@ case "$TIER" in
     # smoke (ISSUE 3 + 5): event-loop-stall regressions in the
     # pipelined crypto coalescer AND a decode-stage host-CPU ratio
     # below 5x (python rung vs device-rung host parse) fail the fast
-    # tier — and the obs gate's fast subset (ISSUE 4): a 1-duty simnet
-    # must export duty-rooted spans through the monitoring endpoint.
+    # tier — the cold-start h2c gate rides the same smoke (ISSUE 6):
+    # a cache-flushed burst must cost >= 5x less host CPU through the
+    # device hash-to-curve path than python h2c — and the obs gate's
+    # fast subset (ISSUE 4): a 1-duty simnet must export duty-rooted
+    # spans through the monitoring endpoint.
     "${PYTEST[@]}" tests/ -m 'not slow' --continue-on-collection-errors
-    python bench_hostplane.py --smoke
+    python bench_hostplane.py --smoke --cold-start
     exec python obs_check.py --fast
     ;;
   hostplane)
     # Wall-clock budget: ~30 s. Tiny shapes, CPU, no jax: asserts the
     # coalescer's decode pool keeps event-loop stall >= 3x below the
     # synchronous path, that double-buffered flushes overlap host
-    # decode with the in-flight device program, and that the device
+    # decode with the in-flight device program, that the device
     # decode rung's host-side parse beats the python bigint decode by
-    # >= 5x host CPU per burst (bench_hostplane.py, ISSUE 5).
-    exec python bench_hostplane.py --smoke
+    # >= 5x host CPU per burst (bench_hostplane.py, ISSUE 5), and
+    # that the cold-start hash-to-curve A/B holds its >= 5x
+    # host-CPU cut (ISSUE 6).
+    exec python bench_hostplane.py --smoke --cold-start
     ;;
   slow)
     # Wall-clock budget: minutes-per-file warm, up to hours cold (big
@@ -62,7 +67,7 @@ case "$TIER" in
     # tier gates on); run when touching kernel families or before
     # cutting a round record.
     "${PYTEST[@]}" tests/ -m 'slow or not slow' --continue-on-collection-errors
-    python bench_hostplane.py --smoke
+    python bench_hostplane.py --smoke --cold-start
     exec python obs_check.py
     ;;
   obs)

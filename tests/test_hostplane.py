@@ -741,3 +741,386 @@ def test_module_caches_shared_by_coalescer_decode(monkeypatch):
     assert asyncio.run(plane.verify(items)) == [True, True]
     plane.close()
     assert len(pk_calls) == 1  # one pubkey, decoded exactly once
+
+
+# ---------------------------------------------------------------------------
+# bulk cache warm-up (ISSUE 6): PointCache.put, warm_point_caches rungs,
+# the coalescer warm-up lifecycle, and the h2c kernel-family jit gate
+# ---------------------------------------------------------------------------
+
+
+def _fresh_caches(monkeypatch, maxsize: int = 64):
+    """Swap the module point caches for empty ones so warm-up tests
+    never see (or leave) state from other tests."""
+    from charon_tpu.tbls import tpu_impl
+
+    pk = tpu_impl.make_point_cache(tpu_impl._decode_pubkey_point, maxsize)
+    msg = tpu_impl.make_point_cache(tpu_impl._decode_msg_point, maxsize)
+    monkeypatch.setattr(tpu_impl, "_cached_pubkey_point", pk)
+    monkeypatch.setattr(tpu_impl, "_cached_msg_point", msg)
+    return pk, msg
+
+
+def test_point_cache_bulk_put_never_decodes_and_evicts_lru():
+    """put() is the warm-up entry: inserted keys hit without ever
+    invoking the decoder, eviction respects maxsize in LRU order, and
+    cache_info mirrors the lru_cache surface the metrics read."""
+    from charon_tpu.tbls import tpu_impl
+
+    def explode(data):  # a put key must never reach the decoder
+        raise AssertionError("decode called for a warmed key")
+
+    cache = tpu_impl.make_point_cache(explode, maxsize=2)
+    cache.put(b"a", 1)
+    cache.put(b"b", 2)
+    assert b"a" in cache and b"b" in cache
+    assert cache(b"a") == 1 and cache(b"b") == 2  # hits, no decode
+    cache.put(b"c", 3)  # evicts a (LRU after the a/b hits above)
+    assert b"a" not in cache and b"b" in cache and b"c" in cache
+    info = cache.cache_info()
+    assert (info.hits, info.misses, info.currsize, info.maxsize) == (
+        2, 0, 2, 2,
+    )
+    cache.cache_clear()
+    assert cache.cache_info().currsize == 0
+
+
+def test_warm_point_caches_python_rung_idempotent(monkeypatch):
+    """The python rung (device=False — the jax-less / CPU fallback)
+    bulk-decodes on host, skips invalid lanes WITHOUT raising, and a
+    re-warm of a superset pays only the delta."""
+    from charon_tpu.tbls import tpu_impl
+
+    pk_cache, msg_cache = _fresh_caches(monkeypatch)
+    items = _sig_items(1)
+    pk = items[0][0]
+    stats = tpu_impl.warm_point_caches(
+        pubkeys=[pk, b"\x00" * 48],  # second: flagless -> invalid
+        messages=[b"root-1"],
+        device=False,
+    )
+    assert stats["pubkey"] == {
+        "device": 0, "python": 1, "cached": 0, "invalid": 1,
+    }
+    assert stats["message"]["python"] == 1
+    assert stats["seconds"] >= 0
+    assert pk in pk_cache and b"root-1" in msg_cache
+    # the warmed entries are REAL decodes (spot-check vs the oracle)
+    from charon_tpu.crypto import h2c
+
+    assert msg_cache(b"root-1") == h2c.hash_to_g2(b"root-1")
+    # rotation re-warm: old keys are cached, only the delta decodes
+    stats2 = tpu_impl.warm_point_caches(
+        pubkeys=[pk], messages=[b"root-1", b"root-2"], device=False
+    )
+    assert stats2["pubkey"] == {
+        "device": 0, "python": 0, "cached": 1, "invalid": 0,
+    }
+    assert stats2["message"]["cached"] == 1
+    assert stats2["message"]["python"] == 1
+
+
+def test_warm_point_caches_device_engine_inserts_only_valid(monkeypatch):
+    """The device rung feeds bulk-kernel outputs into the caches via
+    put(); lanes the device masks invalid are NOT inserted (the
+    on-demand decode re-raises the precise error later), and chunking
+    splits the batch."""
+    from charon_tpu.tbls import tpu_impl
+
+    pk_cache, msg_cache = _fresh_caches(monkeypatch)
+    calls = []
+
+    class FakeEngine:
+        def decompress_g1_batch(self, batch, subgroup_check=True):
+            calls.append(("g1", list(batch)))
+            return [f"pt-{b.hex()[:4]}" for b in batch], [
+                b[0] != 0xFF for b in batch
+            ]
+
+        def hash_to_g2_batch(self, batch):
+            calls.append(("h2c", list(batch)))
+            return [f"h2c-{b.hex()[:4]}" for b in batch], [True] * len(batch)
+
+    keys = [bytes([i]) * 48 for i in (1, 2, 0xFF)]
+    msgs = [bytes([i]) * 32 for i in (5, 6, 7)]
+    stats = tpu_impl.warm_point_caches(
+        pubkeys=keys, messages=msgs, engine=FakeEngine(), device=True,
+        chunk=2,
+    )
+    assert stats["pubkey"] == {
+        "device": 2, "python": 0, "cached": 0, "invalid": 1,
+    }
+    assert stats["message"]["device"] == 3
+    assert [kind for kind, _ in calls] == ["g1", "g1", "h2c", "h2c"]
+    assert keys[0] in pk_cache and keys[1] in pk_cache
+    assert keys[2] not in pk_cache  # invalid lane never inserted
+    assert all(m in msg_cache for m in msgs)
+
+
+def test_warm_point_caches_caps_at_capacity_reports_overflow(monkeypatch):
+    """A key set past the cache capacity warms only the LAST cap keys
+    (the ones that survive insertion order) and reports the rest as
+    overflow — no device/host work burned on lanes eviction would
+    discard, no 'warmed' claim for keys that are not."""
+    from charon_tpu.tbls import tpu_impl
+
+    cache = tpu_impl.make_point_cache(tpu_impl._decode_msg_point, 2)
+    monkeypatch.setattr(tpu_impl, "_cached_msg_point", cache)
+    msgs = [b"m%d" % i for i in range(5)]
+    stats = tpu_impl.warm_point_caches(messages=msgs, device=False)
+    assert stats["message"]["python"] == 2
+    assert stats["message"]["overflow"] == 3
+    assert msgs[-1] in cache and msgs[-2] in cache
+    assert all(m not in cache for m in msgs[:3])
+
+
+def test_warm_point_caches_device_failure_steps_down_not_raises(monkeypatch):
+    """A device failure mid-pass steps the REST of the warm-up down to
+    the python rung (PR 2 ladder) — a dead tunnel can degrade a
+    rotation warm, never abort it."""
+    from charon_tpu.tbls import tpu_impl
+
+    _, msg_cache = _fresh_caches(monkeypatch)
+
+    class DyingEngine:
+        def hash_to_g2_batch(self, batch):
+            raise RuntimeError("injected device failure")
+
+        decompress_g1_batch = hash_to_g2_batch
+
+    msgs = [b"a" * 32, b"b" * 32, b"c" * 32]
+    stats = tpu_impl.warm_point_caches(
+        messages=msgs, engine=DyingEngine(), device=True, chunk=2
+    )
+    # first chunk hit the failure and stepped down; EVERY lane still
+    # warmed on host (the failed chunk retries on the python rung)
+    assert stats["message"] == {
+        "device": 0, "python": 3, "cached": 0, "invalid": 0,
+    }
+    assert all(m in msg_cache for m in msgs)
+
+
+class WarmFakePlane(ParsedFakePlane):
+    """ParsedFakePlane + the sharded warm-program host APIs, recording
+    which thread drove them (the warm-up must never ride the serialized
+    device lane) and holding the device lane busy on demand."""
+
+    def __init__(self, t: int, verify_sleep: float = 0.0):
+        super().__init__(t)
+        self.verify_sleep = verify_sleep
+        self.flush_started = threading.Event()
+        self.warm_calls: list[tuple[str, int, str]] = []
+
+    def verify_packed_parsed(self, arrays, rand, n: int):
+        self.flush_started.set()
+        if self.verify_sleep:
+            time.sleep(self.verify_sleep)
+        return super().verify_packed_parsed(arrays, rand, n)
+
+    def decompress_g1_host(self, encoded):
+        from charon_tpu.crypto import g1g2
+
+        self.warm_calls.append(
+            ("g1", len(encoded), threading.current_thread().name)
+        )
+        pts, valid = [], []
+        for enc in encoded:
+            try:
+                pts.append(g1g2.g1_from_bytes(bytes(enc)))
+                valid.append(True)
+            except ValueError:
+                pts.append(None)
+                valid.append(False)
+        return pts, valid
+
+    def hash_to_g2_host(self, msgs):
+        from charon_tpu.crypto import h2c
+
+        self.warm_calls.append(
+            ("h2c", len(msgs), threading.current_thread().name)
+        )
+        return [h2c.hash_to_g2(bytes(m)) for m in msgs], [True] * len(msgs)
+
+
+def test_warm_caches_device_rung_rotation_rewarm(monkeypatch):
+    """The coalescer warm-up lifecycle: a warm pass decodes through the
+    plane's warm programs on a dedicated worker thread, feeds the
+    module caches, fires warmup_hook; a rotation re-warm pays only the
+    delta; and the warm-up lanes land in the new metric families."""
+    from charon_tpu.app.metrics import ClusterMetrics
+    from charon_tpu.crypto import h2c
+
+    pk_cache, msg_cache = _fresh_caches(monkeypatch)
+    items = _sig_items(1)
+    pk = items[0][0]
+    plane = WarmFakePlane(T)
+    metrics = ClusterMetrics("0xhash", "c", "node0")
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device")
+    coal.warmup_hook = metrics.observe_warmup
+    try:
+        stats = asyncio.run(
+            coal.warm_caches(pubkeys=[pk], messages=[b"slot-root-1"])
+        )
+        assert stats["pubkey"]["device"] == 1
+        assert stats["message"]["device"] == 1
+        assert pk in pk_cache
+        assert msg_cache(b"slot-root-1") == h2c.hash_to_g2(b"slot-root-1")
+        # every warm call ran on the dedicated warm-up thread
+        assert plane.warm_calls and all(
+            name.startswith("crypto-warmup") for _, _, name in plane.warm_calls
+        )
+        # rotation: superset re-warm decodes ONLY the new message
+        stats2 = asyncio.run(
+            coal.warm_caches(
+                pubkeys=[pk], messages=[b"slot-root-1", b"slot-root-2"]
+            )
+        )
+        assert stats2["pubkey"] == {
+            "device": 0, "python": 0, "cached": 1, "invalid": 0,
+        }
+        assert stats2["message"]["device"] == 1
+        assert stats2["message"]["cached"] == 1
+        assert coal.warmups == 2 and coal.warmup_lanes == 3
+    finally:
+        coal.close()
+    out = metrics.render().decode()
+    assert 'tpu_point_cache_warmup_lanes_total{cache="pubkey"' in out
+    assert 'source="device"' in out and 'source="cached"' in out
+    assert "tpu_point_cache_warmup_seconds_count" in out
+
+
+def test_warm_caches_does_not_serialize_behind_live_flush(monkeypatch):
+    """A warm-up racing a live flush must complete while the device
+    lane is still busy — it owns its own thread, never queues behind
+    the serialized flush lane (the rotation-before-next-slot
+    contract)."""
+    _fresh_caches(monkeypatch)
+    items = _sig_items(2)
+    plane = WarmFakePlane(T, verify_sleep=0.8)
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device")
+
+    async def main():
+        flush = asyncio.create_task(coal.verify(items))
+        await asyncio.get_running_loop().run_in_executor(
+            None, plane.flush_started.wait, 5.0
+        )
+        t0 = time.monotonic()
+        stats = await coal.warm_caches(messages=[b"rotation-root"])
+        warm_elapsed = time.monotonic() - t0
+        assert not flush.done(), "device flush finished before warm-up?"
+        res = await flush
+        return stats, warm_elapsed, res
+
+    try:
+        stats, warm_elapsed, res = asyncio.run(main())
+    finally:
+        coal.close()
+    assert res == [True, True]
+    assert stats["message"]["device"] == 1
+    assert warm_elapsed < 0.6, (
+        f"warm-up serialized behind the live flush ({warm_elapsed:.2f}s)"
+    )
+
+
+def test_warm_caches_python_rung_when_plane_lacks_warm_api(monkeypatch):
+    """Planes without the warm programs (python decode rung, test
+    fakes) fall back to the host bigint warm — still off the loop,
+    still feeding the caches."""
+    _fresh_caches(monkeypatch)
+    coal = SlotCoalescer(ParsedFakePlane(T), window=0.01,
+                         decode_workers=0, decode_mode="device")
+    try:
+        stats = asyncio.run(coal.warm_caches(messages=[b"cold-root"]))
+    finally:
+        coal.close()
+    assert stats["message"]["python"] == 1
+    assert stats["message"]["device"] == 0
+
+
+def test_warm_caches_jaxless_host_reports_skip(monkeypatch):
+    """On a host where the tbls device backend cannot import (no jax),
+    warm_caches reports the skip instead of failing startup."""
+    import sys
+
+    import charon_tpu.tbls as tbls_pkg
+
+    monkeypatch.setitem(sys.modules, "charon_tpu.tbls.tpu_impl", None)
+    monkeypatch.delattr(tbls_pkg, "tpu_impl", raising=False)
+    coal = SlotCoalescer(WarmFakePlane(T), window=0.01,
+                         decode_workers=0, decode_mode="device")
+    try:
+        stats = asyncio.run(
+            coal.warm_caches(pubkeys=[b"\x01" * 48], messages=[b"m"])
+        )
+    finally:
+        coal.close()
+    assert stats["pubkey"] == {"skipped": 1}
+    assert stats["message"] == {"skipped": 1}
+
+
+def test_node_rewarm_hook_routes_to_plane(monkeypatch):
+    """Node.rewarm_point_caches (the validator-set rotation hook) rides
+    the coalescer warm path when a crypto plane is installed."""
+    from charon_tpu.app.metrics import ClusterMetrics
+
+    # app.run pulls the p2p identity stack; hosts without the optional
+    # `cryptography` wheel still cover the coalescer-level warm path
+    # in the tests above
+    run_mod = pytest.importorskip("charon_tpu.app.run")
+    Node = run_mod.Node
+
+    _fresh_caches(monkeypatch)
+    plane = WarmFakePlane(T)
+    coal = SlotCoalescer(plane, window=0.01, decode_workers=0,
+                         decode_mode="device")
+    node = Node(
+        config=None, lock=None, life=None, scheduler=None, vapi=None,
+        vapi_router=None, p2p=None, bcast=None, tracker=None,
+        metrics=ClusterMetrics("0x", "c", "n0"), beacon=None,
+        crypto_plane=coal,
+    )
+    try:
+        stats = asyncio.run(node.rewarm_point_caches(messages=[b"rot"]))
+    finally:
+        coal.close()
+    assert stats["message"]["device"] == 1
+    assert [k for k, _, _ in plane.warm_calls] == ["h2c"]
+
+
+def test_h2c_kernel_family_stays_on_bucket_ladder(monkeypatch):
+    """The ISSUE 6 hash-to-curve kernels ride the SAME pow2 ladder as
+    every other family: 50 random hash_to_g2_batch sizes compile at
+    most one program per bucket (field work monkeypatched to a
+    shape-faithful fake BEFORE any trace — compile-free; the jit
+    accounting is the real one)."""
+    import random
+
+    import jax.numpy as jnp
+
+    from charon_tpu.ops import blsops
+    from charon_tpu.ops import sswu as SSWU
+
+    traced_shapes: list[int] = []
+
+    def fake_h2c(ctx, fr_ctx, u0, u1, s0, s1, host_ok=None):
+        traced_shapes.append(int(u0[0].shape[0]))
+        return (u0, u0), jnp.ones(u0[0].shape[:-1], bool)
+
+    monkeypatch.setattr(SSWU, "hash_to_g2_graph", fake_h2c)
+    blsops.clear_kernel_caches()  # rebuild wrappers over the fake
+    try:
+        engine = blsops.BlsEngine()
+        lane = SSWU.hash_to_field_lane(b"ladder-probe")
+        rng = random.Random(23)
+        sizes = [rng.randrange(1, 200) for _ in range(50)]
+        for n in sizes:
+            pts, valid = engine.hash_to_g2_batch([lane] * n)
+            assert len(valid) == n
+        ladder = {blsops.bucket_lanes(n) for n in sizes}
+        assert sorted(set(traced_shapes)) == sorted(ladder)
+        assert len(traced_shapes) == len(ladder) <= 8
+        assert blsops.jit_cache_size() == len(ladder)
+    finally:
+        blsops.clear_kernel_caches()  # drop the fake for later tests

@@ -1,0 +1,262 @@
+"""Device hash-to-curve (ISSUE 6): the RFC 9380 official test vectors
+for `BLS12381G2_XMD:SHA-256_SSWU_RO_`, the endomorphism host oracles
+(G1 GLV phi, G2 psi^2 / psi cofactor clearing), and the device SSWU +
+isogeny + cofactor-clearing kernel vs the crypto/h2c.py oracle.
+
+The official vectors double as the kernel-vs-oracle fixture: the same
+five messages that pin the python path (via the RFC appendix J.10.1
+points) are replayed through `hash_to_g2_batch`, so a kernel drift
+fails against the RFC itself, not just against our own python code.
+
+Host-oracle tests are jax-free; the kernel battery packs every lane
+into ONE batch so the tier pays exactly one compile.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.crypto import g1g2, h2c
+
+P = F.P
+_RNG = random.Random(6)
+
+# ---------------------------------------------------------------------------
+# RFC 9380 appendix J.10.1 — BLS12381G2_XMD:SHA-256_SSWU_RO_
+# ---------------------------------------------------------------------------
+
+RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+# (msg, P.x = (c0, c1), P.y = (c0, c1)) — the affine hash_to_curve
+# outputs, verbatim from the RFC.
+RFC_VECTORS = [
+    (
+        b"",
+        (
+            0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+            0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+        ),
+        (
+            0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+            0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6,
+        ),
+    ),
+    (
+        b"abc",
+        (
+            0x02C2D18E033B960562AAE3CAB37A27CE00D80CCD5BA4B7FE0E7A210245129DBEC7780CCC7954725F4168AFF2787776E6,
+            0x139CDDBCCDC5E91B9623EFD38C49F81A6F83F175E80B06FC374DE9EB4B41DFE4CA3A230ED250FBE3A2ACF73A41177FD8,
+        ),
+        (
+            0x1787327B68159716A37440985269CF584BCB1E621D3A7202BE6EA05C4CFE244AEB197642555A0645FB87BF7466B2BA48,
+            0x00AA65DAE3C8D732D10ECD2C50F8A1BAF3001578F71C694E03866E9F3D49AC1E1CE70DD94A733534F106D4CEC0EDDD16,
+        ),
+    ),
+    (
+        b"abcdef0123456789",
+        (
+            0x121982811D2491FDE9BA7ED31EF9CA474F0E1501297F68C298E9F4C0028ADD35AEA8BB83D53C08CFC007C1E005723CD0,
+            0x190D119345B94FBD15497BCBA94ECF7DB2CBFD1E1FE7DA034D26CBBA169FB3968288B3FAFB265F9EBD380512A71C3F2C,
+        ),
+        (
+            0x05571A0F8D3C08D094576981F4A3B8EDA0A8E771FCDCC8ECCEAF1356A6ACF17574518ACB506E435B639353C2E14827C8,
+            0x0BB5E7572275C567462D91807DE765611490205A941A5A6AF3B1691BFE596C31225D3AABDF15FAFF860CB4EF17C7C3BE,
+        ),
+    ),
+    (
+        b"q128_" + b"q" * 128,
+        (
+            0x19A84DD7248A1066F737CC34502EE5555BD3C19F2ECDB3C7D9E24DC65D4E25E50D83F0F77105E955D78F4762D33C17DA,
+            0x0934ABA516A52D8AE479939A91998299C76D39CC0C035CD18813BEC433F587E2D7A4FEF038260EEF0CEF4D02AAE3EB91,
+        ),
+        (
+            0x14F81CD421617428BC3B9FE25AFBB751D934A00493524BC4E065635B0555084DD54679DF1536101B2C979C0152D09192,
+            0x09BCCCFA036B4847C9950780733633F13619994394C23FF0B32FA6B795844F4A0673E20282D07BC69641CEE04F5E5662,
+        ),
+    ),
+    (
+        b"a512_" + b"a" * 512,
+        (
+            0x01A6BA2F9A11FA5598B2D8ACE0FBE0A0EACB65DECEB476FBBCB64FD24557C2F4B18ECFC5663E54AE16A84F5AB7F62534,
+            0x11FCA2FF525572795A801EED17EB12785887C7B63FB77A42BE46CE4A34131D71F7A73E95FEE3F812AEA3DE78B4D01569,
+        ),
+        (
+            0x0B6798718C8AED24BC19CB27F866F1C9EFFCDBF92397AD6448B5C9DB90D2B9DA6CBABF48ADC1ADF59A1A28344E79D57E,
+            0x03A47F8E6D1763BA0CAD63D6114C0ACCBEF65707825A511B251A660A9B3994249AE4E63FAC38B23DA0C398689EE2AB52,
+        ),
+    ),
+]
+
+
+def test_rfc9380_official_vectors_python_path():
+    """The python oracle (expand_message_xmd -> hash_to_field -> SSWU ->
+    isogeny -> psi cofactor clearing) reproduces every official
+    appendix J.10.1 point bit-exactly — including through the
+    endomorphism cofactor split that replaced the [h_eff]P ladder."""
+    for msg, x, y in RFC_VECTORS:
+        got = h2c.hash_to_g2(msg, RFC_DST)
+        assert got == (x, y), f"RFC vector mismatch for msg={msg[:16]!r}"
+        assert g1g2.g2_in_subgroup_psi(got)
+
+
+def test_hash_to_field_lane_matches_oracle():
+    """ops/sswu.hash_to_field_lane (the host half of the device path,
+    jax-free) ships exactly the oracle's hash_to_field outputs plus
+    their RFC sgn0 bits."""
+    from charon_tpu.ops import sswu
+
+    for msg in (b"", b"abc", b"\x00" * 32, b"duty-root"):
+        for dst in (RFC_DST, h2c.DST_POP):
+            lane = sswu.hash_to_field_lane(msg, dst)
+            u0, u1 = h2c.hash_to_field_fp2(msg, 2, dst)
+            assert (lane.u0, lane.u1) == (u0, u1)
+            assert lane.sgn0 == bool(F.fp2_sgn0(u0))
+            assert lane.sgn1 == bool(F.fp2_sgn0(u1))
+
+
+# ---------------------------------------------------------------------------
+# endomorphism host oracles (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _rand_g1() -> tuple:
+    return g1g2.g1_mul_raw(g1g2.G1_GEN, _RNG.randrange(1, F.R))
+
+
+def _rand_g2() -> tuple:
+    return g1g2.g2_mul_raw(g1g2.G2_GEN, _RNG.randrange(1, F.R))
+
+
+def _g1_on_curve_not_in_subgroup() -> tuple:
+    while True:
+        x = _RNG.randrange(P)
+        y = F.fp_sqrt((x * x * x + g1g2.B1) % P)
+        if y is None:
+            continue
+        pt = (x, y)
+        if not g1g2.g1_in_subgroup(pt):
+            return pt
+
+
+def _g2_on_curve_not_in_subgroup() -> tuple:
+    while True:
+        x = (_RNG.randrange(P), _RNG.randrange(P))
+        y = F.fp2_sqrt(F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), g1g2.B2))
+        if y is None:
+            continue
+        pt = (x, y)
+        if not g1g2.g2_in_subgroup(pt):
+            return pt
+
+
+def test_g1_glv_oracle_matches_full_ladder():
+    """g1_in_subgroup_phi (the 127-bit lambda ladder the device G1
+    kernel mirrors) agrees with the [r]P definition on subgroup points,
+    on-curve non-subgroup points, and identity."""
+    for _ in range(4):
+        assert g1g2.g1_in_subgroup_phi(_rand_g1())
+    for _ in range(2):
+        pt = _g1_on_curve_not_in_subgroup()
+        assert not g1g2.g1_in_subgroup_phi(pt)
+        assert not g1g2.g1_in_subgroup(pt)
+    assert g1g2.g1_in_subgroup_phi(None)
+
+
+def test_g1_phi_acts_as_lambda():
+    pt = _rand_g1()
+    assert g1g2.g1_phi(pt) == g1g2.g1_mul_raw(pt, g1g2.G1_LAMBDA)
+
+
+def test_psi2_collapsed_matches_double_psi():
+    """The collapsed linear psi^2 (one Fp scale + negation — what the
+    device cofactor graph runs) equals psi applied twice on arbitrary
+    E' points, not just subgroup ones."""
+    for pt in (_rand_g2(), _g2_on_curve_not_in_subgroup()):
+        assert g1g2.g2_psi2(pt) == g1g2.g2_psi(g1g2.g2_psi(pt))
+    assert g1g2.g2_psi2(None) is None
+
+
+def test_psi_cofactor_clearing_matches_heff_ladder():
+    """g2_clear_cofactor_psi == [h_eff]P on arbitrary on-curve points —
+    the identity the whole cold-path speedup rests on. Checked on
+    pre-clearing (non-subgroup) points, where a wrong split would
+    actually diverge."""
+    for _ in range(2):
+        pt = _g2_on_curve_not_in_subgroup()
+        assert g1g2.g2_clear_cofactor_psi(pt) == g1g2.g2_mul_raw(
+            pt, h2c.H_EFF
+        )
+        assert g1g2.g2_in_subgroup(g1g2.g2_clear_cofactor_psi(pt))
+    # and on a subgroup point (clearing acts as [h_eff mod r])
+    pt = _rand_g2()
+    assert g1g2.g2_clear_cofactor_psi(pt) == g1g2.g2_mul_raw(pt, h2c.H_EFF)
+    assert g1g2.g2_clear_cofactor_psi(None) is None
+
+
+def test_single_sourced_constants_imported_not_redefined():
+    """ops/decompress.py and ops/sswu.py must IMPORT the endomorphism
+    constants from the g1g2 host oracle (the PR 5 review contract) —
+    same objects, not equal copies — and the oracle self-asserts at
+    import (g1g2._endo_selfcheck)."""
+    from charon_tpu.ops import decompress as DEC
+    from charon_tpu.ops import sswu as SSWU
+
+    assert DEC.PSI_CX is g1g2.PSI_CX and DEC.PSI_CY is g1g2.PSI_CY
+    assert DEC.G1_BETA is g1g2.G1_BETA or DEC.G1_BETA == g1g2.G1_BETA
+    assert DEC.G1_LAMBDA == g1g2.G1_LAMBDA
+    assert SSWU.PSI2_CX == g1g2.PSI2_CX
+    g1g2._endo_selfcheck()  # idempotent, must not raise
+
+
+# ---------------------------------------------------------------------------
+# device kernel vs oracle (one compile for the whole battery)
+# ---------------------------------------------------------------------------
+
+
+_KERNEL_SCRIPT_BODY = """
+from test_sswu import RFC_DST, RFC_VECTORS
+from charon_tpu.crypto import h2c
+from charon_tpu.ops import blsops, sswu
+
+# One batch, mixed DSTs via pre-hashed lanes (the DST only exists on
+# host): the five official RFC points + three POP-DST duty roots.
+lanes = [sswu.hash_to_field_lane(msg, RFC_DST) for msg, _, _ in RFC_VECTORS]
+pop_msgs = [b"\\x00" * 32, b"duty-root-1", b"duty-root-2"]
+lanes += [sswu.hash_to_field_lane(m, h2c.DST_POP) for m in pop_msgs]
+pts, valid = blsops.default_engine().hash_to_g2_batch(lanes)
+assert valid == [True] * len(lanes), "mask mismatch in SSWU battery"
+for (msg, x, y), pt in zip(RFC_VECTORS, pts):
+    assert pt == (x, y), f"device point != RFC vector for {msg[:16]!r}"
+for msg, pt in zip(pop_msgs, pts[len(RFC_VECTORS):]):
+    assert pt == h2c.hash_to_g2(msg), f"device point != oracle for {msg!r}"
+
+# Raw bytes in, host hashing inside the engine — the bulk warm-up entry
+# shape (reuses the already-compiled bucket-8 program).
+msgs = [b"rot-%d" % i for i in range(5)]
+pts2, valid2 = blsops.default_engine().hash_to_g2_batch(msgs)
+assert valid2 == [True] * 5, "mask mismatch on raw-message entry"
+for m, pt in zip(msgs, pts2):
+    assert pt == h2c.hash_to_g2(m), f"device point != oracle for {m!r}"
+print("SSWU-KERNEL-OK")
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings("ignore")
+def test_hash_to_g2_kernel_vs_rfc_vectors_and_oracle():
+    """The device SSWU + 3-isogeny + psi-cofactor-clearing program
+    reproduces the official RFC 9380 points AND the python oracle on
+    POP-DST duty roots, with zero mask mismatches — the ISSUE 6
+    kernel-vs-oracle acceptance battery. Fresh-subprocess isolated:
+    the h2c program is a LARGE cold compile (two sqrt-chain SSWU maps
+    + cofactor ladders), exactly the trigger for the jaxlib
+    persistent-cache segfault flake (CI.md)."""
+    from isolation_util import ISOLATED_HEADER, run_isolated
+
+    run_isolated(
+        ISOLATED_HEADER + _KERNEL_SCRIPT_BODY, "SSWU-KERNEL-OK",
+        timeout=3000,
+    )
