@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""bench_wire.py — wire-path host-CPU A/B for the schema-compiled
+binary codec and the vectorized bytes->limb packing (ISSUE 7
+acceptance). Jax-free: pure-python codec work plus numpy; no device,
+no compiles, CI-safe.
+
+Codec A/B: a burst of hot transport frames (ParSigEx attestation sets,
+randao sets, QBFT pre-prepare with justifications — the frames a
+slot-tick gossip burst is made of) runs through BOTH envelope codecs
+exactly as p2p/transport.py would:
+
+  * legacy wire path — JSON envelope per peer: a broadcast to the
+    n-1 peers of an n-node cluster encodes the envelope once PER PEER
+    (the pre-ISSUE-7 transport behavior), and every inbound frame pays
+    a json.loads + registry walk;
+  * binary wire path — the envelope encodes ONCE per broadcast
+    (transport's single-encode cache) and each inbound frame decodes
+    via the compiled-schema binary decoder.
+
+`wire_host_cpu_ratio` is the per-node host CPU of one gossip exchange
+(n-1 sends + n-1 receives) legacy vs binary — the number the --smoke
+gate asserts (>= 5x by default, measured twice before failing). Pure
+per-frame encode/decode ratios are reported alongside.
+
+Bytes->limb A/B: a 10k-signature burst of compressed 96-byte G2 wire
+bytes converts to device-ready limb arrays via the pre-ISSUE-7 path
+(per-lane int.from_bytes + the O(lanes*limbs) int_to_limbs shift loop,
+ops/limb.py) vs ONE vectorized bytes_to_limbs_batch pass. Gated at
+>= 5x host CPU (the TPU 12-bit geometry, where the old path was a pure
+Python double loop).
+
+Wired into ci.sh fast + hostplane tiers via --smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+# -- hot-frame corpus --------------------------------------------------------
+
+
+def make_frames(validators: int):
+    """The three hot frame payloads of a slot tick, shaped like the
+    adapters ship them ({"duty", "set"/"msg"+"vals", "tctx"})."""
+    from charon_tpu.core import qbft
+    from charon_tpu.core.eth2data import (
+        Attestation,
+        AttestationData,
+        Checkpoint,
+        ParSignedData,
+        SignedData,
+    )
+    from charon_tpu.core.types import Duty, DutyType, PubKey
+
+    tctx = "ab" * 16 + "-" + "cd" * 8
+    duty = Duty(123456, DutyType.ATTESTER)
+    att = Attestation(
+        aggregation_bits=tuple(bool(i % 3) for i in range(64)),
+        data=AttestationData(
+            slot=123456,
+            index=3,
+            beacon_block_root=b"\x11" * 32,
+            source=Checkpoint(3858, b"\x22" * 32),
+            target=Checkpoint(3859, b"\x33" * 32),
+        ),
+        signature=b"\x44" * 96,
+    )
+
+    def pset(kind, payload):
+        return {
+            PubKey("0x" + (bytes([i + 1]) * 48).hex()): ParSignedData(
+                data=SignedData(kind, payload, bytes([i + 1]) * 96),
+                share_idx=i + 1,
+            )
+            for i in range(validators)
+        }
+
+    att_set = pset("attestation", att)
+    randao_set = pset("randao", 3859)
+    qmsg = qbft.Msg(
+        qbft.MsgType.PRE_PREPARE,
+        duty,
+        1,
+        2,
+        b"\x09" * 32,
+        justification=tuple(
+            qbft.Msg(
+                qbft.MsgType.ROUND_CHANGE,
+                duty,
+                i,
+                2,
+                signature=bytes([i + 1]) * 64,
+            )
+            for i in range(3)
+        ),
+        signature=b"\x0a" * 64,
+    )
+    # (protocol, payload, weight): weights approximate per-slot duty
+    # traffic — every validator attests each epoch (attestation sets
+    # dominate a gossip burst by count), QBFT runs once per duty, and
+    # randao partials only accompany the occasional proposal
+    return [
+        ("parsigex/attestation",
+         {"duty": duty, "set": att_set, "tctx": tctx}, 4),
+        ("parsigex/randao",
+         {"duty": Duty(123456, DutyType.RANDAO),
+          "set": randao_set, "tctx": tctx}, 1),
+        ("qbft/pre-prepare",
+         {"duty": duty, "msg": qmsg,
+          "vals": {b"\x09" * 32: att_set}, "tctx": tctx}, 2),
+    ]
+
+
+def _cpu(fn, reps: int) -> float:
+    """Best-of-3 process CPU seconds for `reps` calls of fn()."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.process_time()
+        for _ in range(reps):
+            fn()
+        best = min(best, time.process_time() - t0)
+    return best
+
+
+def _cpu_interleaved(fns: dict, reps: int, rounds: int = 7) -> dict:
+    """Best-of-N per function, measured in INTERLEAVED rounds so CPU
+    frequency drift / noisy neighbors hit every candidate equally
+    instead of biasing whichever ran last."""
+    best = {k: float("inf") for k in fns}
+    for _ in range(rounds):
+        for k, fn in fns.items():
+            t0 = time.process_time()
+            for _ in range(reps):
+                fn()
+            best[k] = min(best[k], time.process_time() - t0)
+    return best
+
+
+def codec_ab(frames, reps: int, peers: int) -> dict:
+    from charon_tpu.p2p import codec
+
+    per_frame = []
+    tot = {"je": 0.0, "jd": 0.0, "be": 0.0, "bd": 0.0}
+    for proto, msg, weight in frames:
+        wire_j = codec.encode_envelope(proto, "a" * 16, "req", msg, False)
+        wire_b = codec.encode_envelope(proto, "a" * 16, "req", msg, True)
+        assert codec.decode_envelope(wire_b)["d"] == msg
+        assert codec.decode_envelope(wire_j)["d"] == msg
+        best = _cpu_interleaved(
+            {
+                "je": lambda: codec.encode_envelope(
+                    proto, "a" * 16, "req", msg, False
+                ),
+                "be": lambda: codec.encode_envelope(
+                    proto, "a" * 16, "req", msg, True
+                ),
+                "jd": lambda: codec.decode_envelope(wire_j),
+                "bd": lambda: codec.decode_envelope(wire_b),
+            },
+            reps,
+        )
+        je, jd, be, bd = best["je"], best["jd"], best["be"], best["bd"]
+        for k, v in zip(("je", "jd", "be", "bd"), (je, jd, be, bd)):
+            tot[k] += weight * v
+        per_frame.append(
+            {
+                "frame": proto,
+                "weight": weight,
+                "json_bytes": len(wire_j),
+                "binary_bytes": len(wire_b),
+                "encode_ratio": round(je / be, 1) if be else None,
+                "decode_ratio": round(jd / bd, 1) if bd else None,
+            }
+        )
+    # one gossip exchange per node: n-1 sends + n-1 receives. Legacy
+    # re-encodes per peer; the binary transport encodes once per
+    # broadcast (p2p/transport._broadcast_one envelope cache).
+    legacy = peers * (tot["je"] + tot["jd"])
+    binary = tot["be"] + peers * tot["bd"]
+    return {
+        "frames": per_frame,
+        "reps": reps,
+        "peers": peers,
+        "encode_ratio": round(tot["je"] / tot["be"], 2),
+        "decode_ratio": round(tot["jd"] / tot["bd"], 2),
+        "encdec_ratio": round(
+            (tot["je"] + tot["jd"]) / (tot["be"] + tot["bd"]), 2
+        ),
+        "wire_host_cpu_ratio": round(legacy / binary, 2),
+        "legacy_burst_cpu_seconds": round(legacy / reps, 6),
+        "binary_burst_cpu_seconds": round(binary / reps, 6),
+    }
+
+
+# -- bytes -> limb A/B -------------------------------------------------------
+
+
+def limb_ab(lanes: int) -> dict | None:
+    try:
+        import numpy as np
+
+        from charon_tpu.ops import limb
+    except Exception as e:  # pragma: no cover — jax-less host
+        print(f"# limb A/B skipped: {type(e).__name__}: {e}")
+        return None
+
+    import random
+
+    rng = random.Random(7)
+    sig_x = [rng.randrange(limb.P) for _ in range(lanes)]
+    wire = b"".join(v.to_bytes(48, "big") for v in sig_x)
+
+    out = {"lanes": lanes}
+    for ctx in (limb.FP32, limb.FP):
+
+        def old_path():
+            # the pre-ISSUE-7 decode-pool path: per-lane bigint
+            # (int.from_bytes) then the per-int shift loop
+            ints = [
+                int.from_bytes(wire[i * 48 : (i + 1) * 48], "big")
+                for i in range(lanes)
+            ]
+            return np.stack(
+                [
+                    limb.int_to_limbs(
+                        v, ctx.n_limbs, ctx.limb_bits, ctx.np_dtype
+                    )
+                    for v in ints
+                ]
+            )
+
+        def new_path():
+            return limb.ctx_bytes_to_limbs(ctx, wire, item_bytes=48)
+
+        ref, got = old_path(), new_path()
+        assert (ref == got).all(), f"bytes_to_limbs mismatch ({ctx.name})"
+        old_s = _cpu(old_path, 1)
+        # the vectorized pass is faster than one process_time tick:
+        # amortize over 20 calls (and floor the denominator at 0.1 ms
+        # so the reported ratio stays finite JSON)
+        new_s = max(_cpu(new_path, 20) / 20, 1e-4)
+        out[ctx.name] = {
+            "old_seconds": round(old_s, 4),
+            "new_seconds": round(new_s, 5),
+            "ratio": round(old_s / new_s, 1),
+        }
+    out["ratio"] = out[limb.FP32.name]["ratio"]
+    return out
+
+
+def main(args) -> int:
+    frames = make_frames(args.validators)
+    ab = codec_ab(frames, args.reps, args.peers)
+    want = args.assert_wire_ratio if args.smoke else 0.0
+    attempts = 1
+    # transient-load tolerance: remeasure before a verdict sticks (a
+    # genuine regression fails every attempt)
+    while want and ab["wire_host_cpu_ratio"] < want and attempts < 3:
+        print(
+            f"# wire ratio {ab['wire_host_cpu_ratio']}x < {want}x — remeasuring"
+        )
+        ab = codec_ab(frames, args.reps, args.peers)
+        attempts += 1
+    lab = limb_ab(args.lanes)
+    want_limb = args.assert_limb_ratio if (args.smoke and lab) else 0.0
+    limb_attempts = 1
+    while want_limb and lab["ratio"] < want_limb and limb_attempts < 2:
+        print(f"# limb ratio {lab['ratio']}x < {want_limb}x — remeasuring")
+        lab = limb_ab(args.lanes)
+        limb_attempts += 1
+    report = {
+        "bench": "wire",
+        "smoke": args.smoke,
+        "codec_ab": ab,
+        **({"limb_ab": lab} if lab else {}),
+    }
+    print(json.dumps(report, indent=2))
+    print(
+        f"# wire burst host CPU ({args.peers} peers): "
+        f"{ab['legacy_burst_cpu_seconds'] * 1e6:.0f} µs json -> "
+        f"{ab['binary_burst_cpu_seconds'] * 1e6:.0f} µs binary "
+        f"({ab['wire_host_cpu_ratio']}x); per-frame enc "
+        f"{ab['encode_ratio']}x dec {ab['decode_ratio']}x"
+    )
+    if lab:
+        print(
+            f"# bytes->limb {lab['lanes']} lanes: "
+            f"{lab['fp32']['old_seconds'] * 1e3:.0f} ms per-int -> "
+            f"{lab['fp32']['new_seconds'] * 1e3:.1f} ms vectorized "
+            f"({lab['ratio']}x, 12-bit geometry)"
+        )
+    if want and ab["wire_host_cpu_ratio"] < want:
+        print(
+            f"FAIL: binary wire path cut burst host CPU only "
+            f"{ab['wire_host_cpu_ratio']}x < {want}x on {attempts} attempts"
+        )
+        return 1
+    if want_limb and lab["ratio"] < want_limb:
+        print(
+            f"FAIL: vectorized bytes->limb cut host CPU only "
+            f"{lab['ratio']}x < {want_limb}x"
+        )
+        return 1
+    if args.smoke:
+        print("smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--validators", type=int, default=6,
+                    help="validators per partial-signature set")
+    ap.add_argument("--reps", type=int, default=500,
+                    help="codec repetitions per measurement")
+    ap.add_argument("--peers", type=int, default=3,
+                    help="broadcast fan-out (n-1 of the cluster size)")
+    ap.add_argument("--lanes", type=int, default=10000,
+                    help="compressed signatures in the bytes->limb burst")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the A/B gates (CI fast tier)")
+    ap.add_argument("--assert-wire-ratio", type=float, default=5.0,
+                    help="fail unless the binary wire path cuts burst "
+                    "host CPU by at least this factor")
+    ap.add_argument("--assert-limb-ratio", type=float, default=5.0,
+                    help="fail unless bytes_to_limbs_batch beats the "
+                    "per-int path by at least this factor")
+    raise SystemExit(main(ap.parse_args()))

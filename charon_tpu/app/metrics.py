@@ -252,6 +252,38 @@ class ClusterMetrics:
             registry=self.registry,
             buckets=(0.05, 0.2, 1.0, 5.0, 20.0, 60.0, 300.0),
         )
+        # wire codec observability (ISSUE 7): per-frame encode/decode
+        # host CPU and byte volume, attributed to the codec that
+        # carried the frame (binary vs json fallback) — the rollout
+        # dashboard for the binary wire format
+        self.wire_encode_seconds = Histogram(
+            "wire_encode_seconds",
+            "Envelope encode host seconds per transport frame, by codec",
+            labels + ["codec"],
+            registry=self.registry,
+            buckets=(1e-5, 5e-5, 2e-4, 1e-3, 5e-3, 0.02, 0.1),
+        )
+        self.wire_decode_seconds = Histogram(
+            "wire_decode_seconds",
+            "Envelope decode host seconds per transport frame, by codec",
+            labels + ["codec"],
+            registry=self.registry,
+            buckets=(1e-5, 5e-5, 2e-4, 1e-3, 5e-3, 0.02, 0.1),
+        )
+        self.wire_bytes = Counter(
+            "wire_bytes_total",
+            "Transport frame bytes by direction and codec (binary "
+            "broadcast frames are encoded once and written per peer; "
+            "every write counts here)",
+            labels + ["dir", "codec"],
+            registry=self.registry,
+        )
+        self.wire_frames = Counter(
+            "wire_frames_total",
+            "Transport frames by direction and codec",
+            labels + ["dir", "codec"],
+            registry=self.registry,
+        )
         # duty-rooted tracing (ISSUE 4): per-step latency from span
         # ends plus the slow-duty detector's wall-time/budget verdicts
         self.step_latency = Histogram(
@@ -311,6 +343,28 @@ class ClusterMetrics:
         self.labels(self.point_cache_warmup_seconds).observe(
             max(0.0, float(stats.get("seconds", 0.0)))
         )
+
+    def wire_hook(self):
+        """P2PNode.wire_observer sink: called per frame with
+        (direction "tx"|"rx", codec "binary"|"json", frame_bytes,
+        codec_seconds | None). seconds is None for broadcast cache
+        hits — the frame hit the wire but paid no encode (ISSUE 7
+        single-encode broadcast), so only bytes/frames count. Runs on
+        the event loop; prometheus objects are thread-safe anyway."""
+
+        def hook(direction, codec_name, nbytes, seconds) -> None:
+            self.labels(self.wire_bytes, direction, codec_name).inc(nbytes)
+            self.labels(self.wire_frames, direction, codec_name).inc()
+            if seconds is None:
+                return
+            hist = (
+                self.wire_encode_seconds
+                if direction == "tx"
+                else self.wire_decode_seconds
+            )
+            self.labels(hist, codec_name).observe(max(0.0, seconds))
+
+        return hook
 
     def render(self) -> bytes:
         self.observe_point_caches()

@@ -475,6 +475,9 @@ async def build_node(config: Config) -> Node:
             config.node_index, k1_key, specs, lock.lock_hash(),
             relay=relay_client,
         )
+        # wire codec observability (ISSUE 7): per-frame encode/decode
+        # seconds + byte volume by codec (binary vs json fallback)
+        p2p_node.wire_observer = metrics.wire_hook()
         await p2p_node.start()
         # frame-level faults on the live mesh (inert no-op by default)
         faultinject.maybe_wrap_p2p_node(p2p_node)

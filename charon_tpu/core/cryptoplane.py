@@ -34,10 +34,14 @@ math:
     bigint work (milliseconds per lane); submissions ship their items to
     a sized ThreadPoolExecutor in chunks, so a slot-tick burst of N
     partial sigs costs the loop microseconds instead of N×ms.
-  * PACK — once a window closes, array packing (Python ints -> numpy
-    limb arrays) and RLC randomness also run on the decode pool, so
-    window k may pack while the device still executes window k-1
-    (double buffering).
+  * PACK — once a window closes, array packing and RLC randomness also
+    run on the decode pool, so window k may pack while the device still
+    executes window k-1 (double buffering). On the device decode rung
+    the parsed signature lanes pack straight from their raw wire bytes
+    into device-ready limb arrays in one vectorized numpy pass
+    (ops/limb.bytes_to_limbs_batch via ops/decompress.pack_parsed_* —
+    ISSUE 7), retiring the O(lanes*limbs) per-int conversion that used
+    to dominate this stage.
   * DEVICE — a single serialized worker thread launches the compiled
     program, preserving the device-contention and counter-integrity
     guarantees of the original single-lane design.

@@ -11,10 +11,22 @@ Mirrors ref: p2p/ —
   * RegisterHandler (p2p/receive.go:40): async handler per protocol id;
   * ping (p2p/ping.go): continuous keepalive feeding peer-health state.
 
-Frame format: 4-byte big-endian length, then JSON envelope
-{"p": protocol, "id": reqid, "k": "req"|"rsp", "d": codec payload}.
-Max frame 128 MB and 5s/7s recv/send timeouts follow the reference's
-envelope (p2p/sender.go:23-29).
+Frame format (ISSUE 7): 4-byte big-endian length, then the sealed
+envelope. After decryption the first byte discriminates the codec —
+0x01 is a binary v1 envelope (length-prefixed protocol/id fields, raw
+payload bytes, decoded by memoryview slices with no intermediate
+object graph), "{" is the original JSON envelope {"p": protocol,
+"id": reqid, "k": "req"|"rsp", "d": codec payload}. Which format a
+node SENDS is negotiated in the handshake ("wire" field, min of both
+sides, absent = 0 = JSON) so a binary-speaking node interops with a
+JSON-speaking peer frame-for-frame; what it ACCEPTS is sniffed per
+frame, so mixed-version clusters never wedge mid-rollout.
+
+A malformed frame of either codec raises the typed codec.CodecError
+and is dropped-and-counted per frame (codec_dropped) — decode
+strictness must never kill the authenticated connection carrying live
+consensus traffic. Max frame 128 MB and 5s/7s recv/send timeouts
+follow the reference's envelope (p2p/sender.go:23-29).
 """
 
 from __future__ import annotations
@@ -35,6 +47,9 @@ MAX_FRAME = 128 * 1024 * 1024  # ref: p2p/sender.go:26
 SEND_TIMEOUT = 7.0  # ref: p2p/sender.go:28
 RECV_TIMEOUT = 5.0  # ref: p2p/sender.go:27
 HYSTERESIS_FAILS = 3  # suppress errors after this many consecutive fails
+# Highest binary wire format this build speaks (0 = JSON only). The
+# handshake advertises it; each connection sends min(ours, theirs).
+WIRE_VERSION = 1
 
 
 @dataclass(frozen=True)
@@ -66,6 +81,10 @@ class _Conn:
     recv_dir: bytes = b"\x02"
     send_ctr: int = 0
     recv_ctr: int = 0
+    # negotiated wire format this connection SENDS (min of both sides'
+    # advertised versions; 0 = JSON). Inbound frames are sniffed per
+    # frame regardless, so this only selects the outbound encoding.
+    wire: int = 0
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     def _aead(self):
@@ -108,6 +127,7 @@ class P2PNode:
         peers: list[PeerSpec],
         cluster_hash: bytes,
         relay=None,  # p2p.relay.RelayClient for NAT fallback
+        wire_version: int = WIRE_VERSION,  # 0 forces the JSON codec
     ) -> None:
         self.index = index
         self.key = privkey
@@ -115,6 +135,7 @@ class P2PNode:
         self.self_spec = next(p for p in peers if p.index == index)
         self.cluster_hash = cluster_hash
         self.relay = relay
+        self.wire_version = wire_version
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[int, _Conn] = {}
         self._handlers: dict[str, Callable] = {}
@@ -123,6 +144,12 @@ class P2PNode:
         self._ping_task: asyncio.Task | None = None
         self.ping_success: dict[int, bool] = {}
         self._recv_tasks: set[asyncio.Task] = set()
+        # per-frame typed drops (codec.CodecError on a live connection)
+        self.codec_dropped = 0
+        # optional wire metrics sink: called with (direction "tx"|"rx",
+        # codec "binary"|"json", frame_bytes, codec_seconds). Must be
+        # cheap and thread-safe (app/metrics.ClusterMetrics.wire_hook).
+        self.wire_observer: Callable | None = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -226,13 +253,21 @@ class P2PNode:
             )
             if not k1util.verify_bytes(peer.pubkey, digest, sig):
                 raise HandshakeError("bad handshake signature", peer=idx)
+            # wire negotiation: absent field = version 0 (JSON) — the
+            # cross-minor interop floor. Not part of the signed
+            # transcript on purpose: a downgrade costs bytes, not auth.
+            wire = min(self.wire_version, int(h.get("wire", 0)))
             ack = self._transcript(
                 b"charon-tpu-ack-v2", idx, self.index, nonce_s, nonce_c
             )
             _write_frame(
                 writer,
                 json.dumps(
-                    {"idx": self.index, "sig": k1util.sign(self.key, ack).hex()}
+                    {
+                        "idx": self.index,
+                        "sig": k1util.sign(self.key, ack).hex(),
+                        "wire": self.wire_version,
+                    }
                 ).encode(),
             )
             await writer.drain()
@@ -245,6 +280,7 @@ class P2PNode:
         conn = _Conn(
             reader, writer, idx,
             mac_key=key, send_dir=b"\x02", recv_dir=b"\x01",
+            wire=wire,
         )
         self._conns.setdefault(idx, conn)
         self._spawn_recv(conn)
@@ -285,6 +321,7 @@ class P2PNode:
                     "idx": self.index,
                     "nonce": nonce_c.hex(),
                     "sig": k1util.sign(self.key, digest).hex(),
+                    "wire": self.wire_version,
                 }
             ).encode(),
         )
@@ -305,6 +342,7 @@ class P2PNode:
         conn = _Conn(
             reader, writer, peer.index,
             mac_key=key, send_dir=b"\x01", recv_dir=b"\x02",
+            wire=min(self.wire_version, int(a.get("wire", 0))),
         )
         self._spawn_recv(conn)
         return conn
@@ -320,23 +358,36 @@ class P2PNode:
 
     # -- send -------------------------------------------------------------
 
+    def _encode_envelope(
+        self, conn: _Conn, protocol: str, req_id: str, kind: str, msg
+    ) -> bytes:
+        """Envelope bytes in the connection's negotiated codec, feeding
+        the wire observer (tx bytes + encode seconds) when wired."""
+        binary = conn.wire >= 1
+        if self.wire_observer is None:
+            return codec.encode_envelope(protocol, req_id, kind, msg, binary)
+        t0 = time.perf_counter()
+        body = codec.encode_envelope(protocol, req_id, kind, msg, binary)
+        self.wire_observer(
+            "tx",
+            "binary" if binary else "json",
+            len(body),
+            time.perf_counter() - t0,
+        )
+        return body
+
     async def send(self, peer_idx: int, protocol: str, msg, await_response: bool = False):
         """SendAsync / SendReceive (ref: p2p/sender.go:90-95)."""
         req_id = os.urandom(8).hex()
-        envelope = {
-            "p": protocol,
-            "id": req_id,
-            "k": "req",
-            "d": codec._to_jsonable(msg) if msg is not None else None,
-        }
         fut = None
         if await_response:
             fut = asyncio.get_running_loop().create_future()
             self._pending[req_id] = fut
         try:
             conn = await self._get_conn(peer_idx)
+            body = self._encode_envelope(conn, protocol, req_id, "req", msg)
             async with conn.lock:
-                _write_sframe(conn, json.dumps(envelope).encode())
+                _write_sframe(conn, body)
                 await asyncio.wait_for(conn.writer.drain(), SEND_TIMEOUT)
             self._fail_counts[peer_idx] = 0
             if fut is not None:
@@ -353,14 +404,48 @@ class P2PNode:
     def peer_failing(self, peer_idx: int) -> bool:
         return self._fail_counts.get(peer_idx, 0) >= HYSTERESIS_FAILS
 
+    async def _broadcast_one(
+        self, peer_idx: int, protocol: str, req_id: str, msg, cache: dict
+    ) -> None:
+        """One broadcast delivery: the envelope is encoded ONCE per
+        negotiated codec and shared across peers (`cache`) — an n-node
+        gossip burst pays one serialization, not n-1 (ISSUE 7). Safe
+        because broadcast frames are fire-and-forget: the request id is
+        never matched, so peers may share it."""
+        try:
+            conn = await self._get_conn(peer_idx)
+            key = 1 if conn.wire >= 1 else 0
+            body = cache.get(key)
+            if body is None:
+                body = cache[key] = self._encode_envelope(
+                    conn, protocol, req_id, "req", msg
+                )
+            elif self.wire_observer is not None:
+                # cache hit: count the wire bytes, no encode timing
+                self.wire_observer(
+                    "tx", "binary" if key else "json", len(body), None
+                )
+            async with conn.lock:
+                _write_sframe(conn, body)
+                await asyncio.wait_for(conn.writer.drain(), SEND_TIMEOUT)
+            self._fail_counts[peer_idx] = 0
+        except Exception:
+            self._fail_counts[peer_idx] = (
+                self._fail_counts.get(peer_idx, 0) + 1
+            )
+            self._conns.pop(peer_idx, None)
+            raise
+
     async def broadcast(self, protocol: str, msg) -> None:
         """Fire-and-forget to every peer; failures are independent.
         Network errors surface via hysteresis state; programming errors
         (unserializable payloads) are logged loudly — silently dropping
         every frame would stall consensus with healthy-looking pings."""
+        req_id = os.urandom(8).hex()
+        cache: dict = {}
         results = await asyncio.gather(
             *(
-                self.send(idx, protocol, msg)
+                self._broadcast_one(idx, protocol, req_id, msg, cache)
                 for idx in self.peers
             ),
             return_exceptions=True,
@@ -384,6 +469,23 @@ class P2PNode:
         self._recv_tasks.add(task)
         task.add_done_callback(self._recv_tasks.discard)
 
+    def _decode_envelope(self, frame: bytes) -> dict:
+        """Sniff-and-decode one decrypted frame in place (offset walk
+        over the frame bytes; payload bytes fields slice straight out
+        of the buffer), feeding the wire observer (rx bytes + decode
+        seconds)."""
+        if self.wire_observer is None:
+            return codec.decode_envelope(frame)
+        t0 = time.perf_counter()
+        env = codec.decode_envelope(frame)
+        self.wire_observer(
+            "rx",
+            "binary" if frame[:1] != b"{" else "json",
+            len(frame),
+            time.perf_counter() - t0,
+        )
+        return env
+
     async def _recv_loop(self, conn: _Conn) -> None:
         try:
             while True:
@@ -393,26 +495,37 @@ class P2PNode:
                 # connection carrying live consensus traffic (frame
                 # integrity itself is the MAC's job in _read_sframe).
                 try:
-                    env = json.loads(frame)
+                    env = self._decode_envelope(frame)
                     if env["k"] == "rsp":
                         fut = self._pending.pop(env["id"], None)
                         if fut is not None and not fut.done():
-                            fut.set_result(codec._from_jsonable(env["d"]))
+                            fut.set_result(env["d"])
                         continue
                     handler = self._handlers.get(env["p"])
                     if handler is None:
                         continue
-                    msg = (
-                        codec._from_jsonable(env["d"])
-                        if env["d"] is not None
-                        else None
-                    )
                     # Source = the connection's authenticated peer index;
                     # a sender-claimed envelope field would allow
                     # impersonation (ADVICE round 1).
-                    resp = await handler(conn.peer_idx, msg)
+                    resp = await handler(conn.peer_idx, env["d"])
                 except asyncio.CancelledError:
                     raise
+                except codec.CodecError as e:
+                    # typed malformed-frame drop (ISSUE 7 satellite):
+                    # a sealed-but-malformed payload lands here,
+                    # counted, and the transport task lives on. (Raw
+                    # pre-AEAD garbage — chaos_p2p_node's corrupt knob
+                    # — fails the MAC instead and tears down the conn
+                    # by design; see _read_sframe.)
+                    self.codec_dropped += 1
+                    log.warn(
+                        "dropping malformed frame",
+                        topic="p2p",
+                        peer=conn.peer_idx,
+                        dropped=self.codec_dropped,
+                        err=f"CodecError: {e}",
+                    )
+                    continue
                 except Exception as e:
                     log.warn(
                         "dropping bad frame",
@@ -422,14 +535,11 @@ class P2PNode:
                     )
                     continue
                 if resp is not None:
-                    out = {
-                        "p": env["p"],
-                        "id": env["id"],
-                        "k": "rsp",
-                        "d": codec._to_jsonable(resp),
-                    }
+                    body = self._encode_envelope(
+                        conn, env["p"], env["id"], "rsp", resp
+                    )
                     async with conn.lock:
-                        _write_sframe(conn, json.dumps(out).encode())
+                        _write_sframe(conn, body)
                         await conn.writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
@@ -459,7 +569,10 @@ class P2PNode:
 def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     if len(payload) > MAX_FRAME:
         raise ValueError("frame exceeds max size")
-    writer.write(len(payload).to_bytes(4, "big") + payload)
+    # two writes, no header+payload concatenation: the transport never
+    # copies a large frame just to prefix 4 bytes
+    writer.write(len(payload).to_bytes(4, "big"))
+    writer.write(payload)
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> bytes:

@@ -513,6 +513,37 @@ def chaos_p2p_node(node, cfg: ChaosConfig) -> None:
 
     node.send = chaotic_send
 
+    # broadcasts no longer route through send() (single-encode fan-out,
+    # ISSUE 7) — inject the same per-delivery faults on that path too
+    orig_bcast_one = node._broadcast_one
+
+    async def chaotic_broadcast_one(peer_idx, protocol, req_id, msg, cache):
+        roll = rng.random()
+        if roll < cfg.silent_drop:
+            return None
+        if roll < cfg.silent_drop + cfg.drop:
+            raise ConnectionError("chaos: dropped broadcast frame")
+        if roll < cfg.silent_drop + cfg.drop + cfg.corrupt:
+            try:
+                conn = await node._get_conn(peer_idx)
+                from charon_tpu.p2p.transport import _write_frame
+
+                async with conn.lock:
+                    _write_frame(
+                        conn.writer, rng.randbytes(rng.randrange(1, 64))
+                    )
+                    await conn.writer.drain()
+            except Exception:  # noqa: BLE001 — chaos must not crash
+                pass
+            return None
+        if rng.random() < cfg.duplicate:
+            await orig_bcast_one(peer_idx, protocol, req_id, msg, cache)
+        if cfg.delay and rng.random() < cfg.delay:
+            await asyncio.sleep(rng.uniform(0.0, cfg.delay_max))
+        return await orig_bcast_one(peer_idx, protocol, req_id, msg, cache)
+
+    node._broadcast_one = chaotic_broadcast_one
+
 
 async def blast_garbage(
     host: str, port: int, n_frames: int = 50, seed: int = 0

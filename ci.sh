@@ -43,18 +43,24 @@ case "$TIER" in
     # spans through the monitoring endpoint.
     "${PYTEST[@]}" tests/ -m 'not slow' --continue-on-collection-errors
     python bench_hostplane.py --smoke --cold-start
+    # wire-path gate (ISSUE 7): the binary codec must cut a gossip
+    # burst's host CPU >= 5x vs the JSON wire path, and the vectorized
+    # bytes->limb pass must beat the per-int loop >= 5x
+    python bench_wire.py --smoke
     exec python obs_check.py --fast
     ;;
   hostplane)
-    # Wall-clock budget: ~30 s. Tiny shapes, CPU, no jax: asserts the
+    # Wall-clock budget: ~45 s. Tiny shapes, CPU, no jax: asserts the
     # coalescer's decode pool keeps event-loop stall >= 3x below the
     # synchronous path, that double-buffered flushes overlap host
     # decode with the in-flight device program, that the device
     # decode rung's host-side parse beats the python bigint decode by
-    # >= 5x host CPU per burst (bench_hostplane.py, ISSUE 5), and
-    # that the cold-start hash-to-curve A/B holds its >= 5x
-    # host-CPU cut (ISSUE 6).
-    exec python bench_hostplane.py --smoke --cold-start
+    # >= 5x host CPU per burst (bench_hostplane.py, ISSUE 5), that
+    # the cold-start hash-to-curve A/B holds its >= 5x host-CPU cut
+    # (ISSUE 6), and that the wire-path codec + bytes->limb A/Bs hold
+    # their >= 5x cuts (bench_wire.py, ISSUE 7).
+    python bench_hostplane.py --smoke --cold-start
+    exec python bench_wire.py --smoke
     ;;
   slow)
     # Wall-clock budget: minutes-per-file warm, up to hours cold (big
@@ -68,6 +74,7 @@ case "$TIER" in
     # cutting a round record.
     "${PYTEST[@]}" tests/ -m 'slow or not slow' --continue-on-collection-errors
     python bench_hostplane.py --smoke --cold-start
+    python bench_wire.py --smoke
     exec python obs_check.py
     ;;
   obs)

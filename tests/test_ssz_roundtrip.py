@@ -1,0 +1,264 @@
+"""SSZ serialize/_decode round-trip property tests (ISSUE 7 satellite).
+
+Seeded descriptor-driven generation across every SSZType: for any value
+a descriptor can describe, `deserialize(cls, serialize(obj)) == obj`
+and the hash_tree_root is unchanged by the round trip — plus boundary
+batteries (bitlists AT the limit, empty lists, max-size byte lists) and
+strict-offset rejection. Known-root vectors for the consensus
+containers pin against `testdata/` goldens (UPDATE_GOLDEN=1 to
+regenerate), so codec drift in signing-critical roots cannot land
+silently.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import ClassVar
+
+import pytest
+
+from charon_tpu.eth2util import ssz
+from charon_tpu.testutil.golden import require_golden_json
+
+
+# -- test containers covering every descriptor shape -------------------------
+
+
+@dataclass(frozen=True)
+class FixedInner:
+    a: int
+    root: bytes
+
+    ssz_fields: ClassVar = (ssz.UINT64, ssz.BYTES32)
+
+
+@dataclass(frozen=True)
+class VarInner:
+    data: bytes
+    bits: tuple
+
+    ssz_fields: ClassVar = (ssz.ByteList(64), ssz.Bitlist(16))
+
+
+@dataclass(frozen=True)
+class Everything:
+    """One container exercising every descriptor class at once."""
+
+    num: int
+    big: int
+    flag: bool
+    vec: bytes
+    blob: bytes
+    bitv: tuple
+    bitl: tuple
+    nums: tuple
+    fixed_list: tuple
+    var_list: tuple
+    nested: FixedInner
+    var_nested: VarInner
+    fixed_vec: tuple
+
+    ssz_fields: ClassVar = (
+        ssz.UINT64,
+        ssz.Uint256(),
+        ssz.Boolean(),
+        ssz.ByteVector(48),
+        ssz.ByteList(100),
+        ssz.Bitvector(12),
+        ssz.Bitlist(20),
+        ssz.List(ssz.UINT64, 32),
+        ssz.List(ssz.Nested(FixedInner), 8),
+        ssz.List(ssz.Nested(VarInner), 8),
+        ssz.Nested(FixedInner),
+        ssz.Nested(VarInner),
+        ssz.Vector(ssz.Nested(FixedInner), 3),
+    )
+
+
+def make_value(t: ssz.SSZType, rng: random.Random):
+    """Random value conforming to descriptor `t`."""
+    if isinstance(t, ssz.Uint64):
+        return rng.choice([0, 1, 2**64 - 1, rng.randrange(2**64)])
+    if isinstance(t, ssz.Uint256):
+        return rng.choice([0, 2**256 - 1, rng.randrange(2**256)])
+    if isinstance(t, ssz.Boolean):
+        return rng.random() < 0.5
+    if isinstance(t, ssz.ByteVector):
+        return rng.randbytes(t.length)
+    if isinstance(t, ssz.ByteList):
+        n = rng.choice([0, t.limit, rng.randrange(t.limit + 1)])
+        return rng.randbytes(n)
+    if isinstance(t, ssz.Bitvector):
+        return tuple(rng.random() < 0.5 for _ in range(t.length))
+    if isinstance(t, ssz.Bitlist):
+        n = rng.choice([0, t.limit, rng.randrange(t.limit + 1)])
+        return tuple(rng.random() < 0.5 for _ in range(n))
+    if isinstance(t, ssz.Vector):
+        return tuple(make_value(t.elem, rng) for _ in range(t.length))
+    if isinstance(t, ssz.List):
+        n = rng.choice([0, rng.randrange(min(t.limit, 6) + 1)])
+        return tuple(make_value(t.elem, rng) for _ in range(n))
+    if isinstance(t, ssz.Nested):
+        return make_container(t.cls, rng)
+    raise TypeError(f"no generator for {type(t).__name__}")
+
+
+def make_container(cls, rng: random.Random):
+    return cls(*(make_value(t, rng) for t in cls.ssz_fields))
+
+
+def roundtrip(obj) -> None:
+    cls = type(obj)
+    wire = ssz.serialize(obj)
+    back = ssz.deserialize(cls, wire)
+    assert back == obj
+    assert ssz.hash_tree_root(back) == ssz.hash_tree_root(obj)
+    # stability: a second pass serializes identically
+    assert ssz.serialize(back) == wire
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_property_roundtrip_everything(seed):
+    rng = random.Random(seed)
+    roundtrip(make_container(Everything, rng))
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_property_roundtrip_inners(seed):
+    rng = random.Random(1000 + seed)
+    roundtrip(make_container(FixedInner, rng))
+    roundtrip(make_container(VarInner, rng))
+
+
+def test_bitlist_limit_boundaries():
+    t = ssz.Bitlist(8)
+    for n in (0, 1, 7, 8):  # at-limit bitlists are legal
+        bits = tuple(bool(i % 2) for i in range(n))
+        wire = ssz._encode(t, bits)
+        assert ssz._decode(t, wire) == bits
+        assert t.hash_tree_root(bits)
+    with pytest.raises(ValueError):
+        ssz._encode(t, tuple([True] * 9))
+    with pytest.raises(ValueError):
+        t.hash_tree_root(tuple([True] * 9))
+    # the sentinel bit is mandatory on the wire
+    with pytest.raises(ValueError):
+        ssz._decode(t, b"")
+    with pytest.raises(ValueError):
+        ssz._decode(t, b"\x00")
+    # a wire bitlist decoding past the limit is rejected
+    with pytest.raises(ValueError):
+        ssz._decode(t, b"\xff\x03")  # 9 data bits + sentinel
+
+
+def test_bytelist_and_list_boundaries():
+    bl = ssz.ByteList(4)
+    for n in (0, 4):
+        assert ssz._decode(bl, ssz._encode(bl, bytes(n))) == bytes(n)
+    with pytest.raises(ValueError):
+        ssz._encode(bl, bytes(5))
+    with pytest.raises(ValueError):
+        ssz._decode(bl, bytes(5))
+    lst = ssz.List(ssz.UINT64, 2)
+    with pytest.raises(ValueError):
+        ssz._decode(lst, bytes(8 * 3))  # 3 elements > limit
+
+
+def test_strict_offsets_rejected():
+    rng = random.Random(42)
+    obj = make_container(Everything, rng)
+    wire = bytearray(ssz.serialize(obj))
+    # find the first variable-field offset (blob: field index 4; fixed
+    # prefix = 8 + 32 + 1 + 48 = 89 bytes before the first offset)
+    off_pos = 8 + 32 + 1 + 48
+    orig = int.from_bytes(wire[off_pos : off_pos + 4], "little")
+    # first offset must equal the fixed-part size — shifting it breaks
+    wire[off_pos : off_pos + 4] = (orig + 1).to_bytes(4, "little")
+    with pytest.raises(ValueError):
+        ssz.deserialize(Everything, bytes(wire))
+    # truncation is rejected, never silently zero-filled
+    with pytest.raises(ValueError):
+        ssz.deserialize(Everything, bytes(wire[: off_pos // 2]))
+
+
+def test_trailing_bytes_rejected_for_fixed_sequences():
+    obj = FixedInner(5, b"\x01" * 32)
+    wire = ssz.serialize(obj)
+    with pytest.raises(ValueError):
+        ssz.deserialize(FixedInner, wire + b"\x00")
+
+
+# -- consensus containers: round-trip + pinned roots -------------------------
+
+
+def _consensus_samples():
+    from charon_tpu.eth2util import spec
+
+    att_data = spec.AttestationData(
+        slot=123456,
+        index=3,
+        beacon_block_root=b"\x11" * 32,
+        source=spec.Checkpoint(3858, b"\x22" * 32),
+        target=spec.Checkpoint(3859, b"\x33" * 32),
+    )
+    return {
+        "attestation_data": att_data,
+        # bitlist exactly at a byte boundary (8 bits) and mid-byte (11)
+        "attestation_bits8": spec.Attestation(
+            aggregation_bits=tuple(bool(i % 2) for i in range(8)),
+            data=att_data,
+            signature=b"\x44" * 96,
+        ),
+        "attestation_bits11": spec.Attestation(
+            aggregation_bits=tuple(bool(i % 3) for i in range(11)),
+            data=att_data,
+            signature=b"\x44" * 96,
+        ),
+        "header": spec.BeaconBlockHeader(
+            slot=7,
+            proposer_index=11,
+            parent_root=b"\x01" * 32,
+            state_root=b"\x02" * 32,
+            body_root=b"\x03" * 32,
+        ),
+        "voluntary_exit": spec.VoluntaryExit(epoch=900, validator_index=4),
+        "eth1_data": spec.Eth1Data(b"\x05" * 32, 16384, b"\x06" * 32),
+    }
+
+
+def test_consensus_containers_roundtrip():
+    for name, obj in _consensus_samples().items():
+        wire = ssz.serialize(obj)
+        back = ssz.deserialize(type(obj), wire)
+        assert back == obj, name
+        assert ssz.hash_tree_root(obj) == ssz.hash_tree_root(back), name
+
+
+def test_consensus_hash_tree_roots_pinned():
+    """Golden roots: signing-critical hash_tree_root values must never
+    drift (testdata/ssz_roots.json; UPDATE_GOLDEN=1 regenerates)."""
+    require_golden_json(
+        __file__,
+        "ssz_roots.json",
+        {
+            name: ssz.hash_tree_root(obj).hex()
+            for name, obj in _consensus_samples().items()
+        },
+    )
+
+
+def test_known_uint_and_bool_roots():
+    """Spec-trivial vectors computable by hand: basic-type roots are
+    the little-endian value zero-padded to 32 bytes."""
+    assert ssz.UINT64.hash_tree_root(5) == (5).to_bytes(8, "little") + bytes(24)
+    assert ssz.Uint256().hash_tree_root(1) == (1).to_bytes(32, "little")
+    assert ssz.Boolean().hash_tree_root(True) == b"\x01" + bytes(31)
+    assert ssz.Boolean().hash_tree_root(False) == bytes(32)
+    # 32-byte vector roots to itself; 64-byte vector to sha256(a || b)
+    import hashlib
+
+    assert ssz.BYTES32.hash_tree_root(b"\xaa" * 32) == b"\xaa" * 32
+    assert ssz.ByteVector(64).hash_tree_root(
+        b"\xaa" * 32 + b"\xbb" * 32
+    ) == hashlib.sha256(b"\xaa" * 32 + b"\xbb" * 32).digest()

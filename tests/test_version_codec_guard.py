@@ -60,6 +60,7 @@ def test_bad_frame_does_not_kill_connection():
     connection (the reference survives bad protobufs the same way)."""
     import asyncio
 
+    pytest.importorskip("cryptography")
     from charon_tpu.app import k1util
     from charon_tpu.p2p.transport import P2PNode, PeerSpec
 
