@@ -39,6 +39,15 @@ The run FAILS unless the device path cuts cold-burst host CPU by
 Passed alone it runs just the A/B (a quick sizing tool for the
 `--crypto-plane-warmup` flag); `--smoke` includes the gate.
 
+Multi-tenant A/B (ISSUE 8): `--tenants` drives the core/cryptosvc
+service with a victim tenant running paced duty bursts and a flooder
+tenant pouring fire-and-forget bursts far over its admission quota,
+over the same SimPlane device. The run FAILS unless (a) the flooder's
+over-budget work actually sheds (PlaneOverloadError fail-fast) and
+(b) the victim's p99 submit->result latency under flood stays below
+--assert-tenant-ratio (default 2x) of its unflooded baseline — the
+jax-free isolation gate ci.sh's chaos/hostplane tiers ride.
+
 `--smoke` (ci.sh fast tier) runs tiny shapes and FAILS (exit 1) when
 the stall improvement ratio drops below --assert-ratio or the overlap
 hits zero — the event-loop-stall regression guard.
@@ -352,7 +361,146 @@ def _run_h2c_gate(lanes: int, want: float) -> tuple[dict, bool]:
     return ab, ok
 
 
+async def _tenant_phase(items, flood: bool, duties: int, device_s: float):
+    """One service run: victim duties (p99 latency measured) with or
+    without a concurrent flooding tenant. The flooder's quota is a
+    fraction of its offered load, so most of its work sheds at
+    admission and the admitted remainder trickles through its
+    weighted-fair budget."""
+    from charon_tpu.core.cryptoplane import SlotCoalescer
+    from charon_tpu.core.cryptosvc import (
+        CryptoPlaneService,
+        PlaneOverloadError,
+        TenantQuota,
+    )
+
+    _clear_decode_caches()
+    plane = SimPlane(t=3, device_s=device_s)
+    # device decode rung (parse-only host work): the A/B isolates the
+    # SERVICE's scheduling behavior, not python bigint decode — on the
+    # python rung the flooder's admitted lanes would saturate the host
+    # CPU with decompression, which is the decode gate's job to measure
+    coal = SlotCoalescer(
+        plane, window=0.01, decode_workers=2, decode_mode="device"
+    )
+    # round length ~2.5x the device program: the flooder's admitted
+    # remainder (one budget's worth per round, usually ONE flush) can
+    # never saturate the serialized device lane — admission control is
+    # exactly the flow control that keeps the victim's flush from
+    # queueing behind an unbounded flooder backlog
+    svc = CryptoPlaneService(
+        coal, round_lanes=64, round_interval=device_s * 2.5
+    )
+    victim = svc.register("victim", TenantQuota())
+    flooder = svc.register(
+        "flooder", TenantQuota(max_queue_jobs=8, max_queue_lanes=64)
+    )
+    stop = asyncio.Event()
+
+    async def flood_loop():
+        pending: set[asyncio.Task] = set()
+        while not stop.is_set():
+            for _ in range(4):
+
+                async def burst():
+                    try:
+                        await flooder.verify(items * 4)
+                    except PlaneOverloadError:
+                        pass
+
+                task = asyncio.create_task(burst())
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+            await asyncio.sleep(0.002)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    flood_task = asyncio.create_task(flood_loop()) if flood else None
+    latencies: list[float] = []
+    try:
+        for i in range(duties + 3):
+            t0 = time.monotonic()
+            res = await victim.verify(
+                list(items), deadline=time.time() + 5.0
+            )
+            if i >= 3:  # first duties pay cold point-cache decodes
+                latencies.append(time.monotonic() - t0)
+            assert all(res)
+            await asyncio.sleep(device_s * 2)
+    finally:
+        stop.set()
+        if flood_task is not None:
+            await flood_task
+        svc.close()
+        coal.close()
+    latencies.sort()
+    p99 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.99))]
+    return {
+        "p99_seconds": round(p99, 4),
+        "mean_seconds": round(sum(latencies) / len(latencies), 4),
+        "flooder_shed_jobs": sum(svc.tenant("flooder").shed.values()),
+        "flooder_admitted_lanes": svc.tenant("flooder").admitted_lanes,
+        "victim_shed_jobs": sum(svc.tenant("victim").shed.values()),
+    }
+
+
+async def tenants_ab(args) -> tuple[dict, bool]:
+    """Victim p99 with vs without the flood, plus the shed assertion
+    (remeasured once before a verdict — CI-noise discipline)."""
+    items = make_burst(8)
+    duties = 20 if args.smoke else 30
+
+    async def measure():
+        base = await _tenant_phase(items, False, duties, 0.02)
+        flooded = await _tenant_phase(items, True, duties, 0.02)
+        ratio = flooded["p99_seconds"] / max(base["p99_seconds"], 1e-6)
+        return base, flooded, ratio
+
+    base, flooded, ratio = await measure()
+    want = args.assert_tenant_ratio
+    if want and (
+        ratio >= want or flooded["flooder_shed_jobs"] == 0
+    ):
+        print(f"# tenant ratio {ratio:.2f}x (want < {want}x), shed "
+              f"{flooded['flooder_shed_jobs']} — remeasuring")
+        base, flooded, ratio = await measure()
+    ok = not want or (
+        ratio < want
+        and flooded["flooder_shed_jobs"] > 0
+        and flooded["victim_shed_jobs"] == 0
+    )
+    report = {
+        "baseline": base,
+        "flooded": flooded,
+        "victim_p99_ratio": round(ratio, 2),
+    }
+    print(
+        f"# tenant isolation: victim p99 "
+        f"{base['p99_seconds'] * 1000:.0f} ms -> "
+        f"{flooded['p99_seconds'] * 1000:.0f} ms under flood "
+        f"({ratio:.2f}x, want < {want}x), flooder shed "
+        f"{flooded['flooder_shed_jobs']} jobs / admitted "
+        f"{flooded['flooder_admitted_lanes']} lanes"
+    )
+    return report, ok
+
+
 async def main(args) -> int:
+    if args.tenants:
+        # standalone multi-tenant isolation gate (ISSUE 8): jax-free,
+        # SimPlane device — the ci.sh chaos/hostplane tiers' A/B
+        report, ok = await tenants_ab(args)
+        print(json.dumps({"bench": "hostplane-tenants", **report},
+                         indent=2))
+        if not ok:
+            print(
+                f"FAIL: flooding tenant degraded victim p99 "
+                f"{report['victim_p99_ratio']}x (want < "
+                f"{args.assert_tenant_ratio}x) or shed nothing"
+            )
+            return 1
+        print("tenants PASS")
+        return 0
     lanes = 32 if args.smoke else args.lanes
     if args.cold_start and not args.smoke:
         # standalone cold-start A/B: the sizing tool for
@@ -518,4 +666,13 @@ if __name__ == "__main__":
                     help="with --cold-start or --smoke: fail unless "
                     "the device h2c path cuts cold-burst host CPU by "
                     "at least this factor (ISSUE 6 acceptance)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="multi-tenant isolation A/B (ISSUE 8): victim "
+                    "p99 flush latency with vs without a flooding "
+                    "tenant through core/cryptosvc; gates on "
+                    "--assert-tenant-ratio and on the flood shedding")
+    ap.add_argument("--assert-tenant-ratio", type=float, default=2.0,
+                    help="with --tenants: fail unless the victim "
+                    "tenant's p99 latency under flood stays below this "
+                    "multiple of its unflooded baseline")
     raise SystemExit(asyncio.run(main(ap.parse_args())))

@@ -284,6 +284,67 @@ class ClusterMetrics:
             labels + ["dir", "codec"],
             registry=self.registry,
         )
+        self.wire_peer_quarantine = Counter(
+            "wire_peer_quarantine_total",
+            "Temporary peer mutes imposed after repeated malformed "
+            "frames (p2p codec quarantine, exponential backoff)",
+            labels + ["peer_index"],
+            registry=self.registry,
+        )
+        # multi-tenant crypto-plane service (ISSUE 8): per-tenant flush
+        # attribution, admission-shed counts, queue occupancy, breaker
+        # state machine and quarantined flushes — the isolation
+        # dashboard that answers "who is hurting whom" on a shared mesh
+        self.plane_tenant_lanes = Counter(
+            "tpu_plane_tenant_lanes_total",
+            "Crypto lanes flushed through the shared plane, by tenant "
+            "(FlushStats.tenant_lanes attribution)",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
+        self.plane_tenant_shed = Counter(
+            "tpu_plane_tenant_shed_total",
+            "Submissions shed at admission with PlaneOverloadError, by "
+            "tenant and bound hit (jobs = queue depth, lanes = lane "
+            "depth); shed work serves from the submitter's host rung",
+            labels + ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.plane_tenant_queue = Gauge(
+            "tpu_plane_tenant_queue_lanes",
+            "Pending (queued + in-flight) lanes in the tenant's "
+            "submission queue at the most recent admission",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
+        self.plane_tenant_breaker = Gauge(
+            "tpu_plane_tenant_breaker_state",
+            "Per-tenant circuit breaker state "
+            "(0 = closed, 1 = half-open, 2 = open/quarantined)",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
+        self.plane_tenant_breaker_transitions = Counter(
+            "tpu_plane_tenant_breaker_transitions_total",
+            "Breaker state transitions by tenant and entered state",
+            labels + ["tenant", "state"],
+            registry=self.registry,
+        )
+        self.plane_tenant_quarantined = Counter(
+            "tpu_plane_tenant_quarantined_flushes_total",
+            "Dispatches served by the tenant's own quarantine flushes "
+            "(breaker open/half-open) instead of the shared RLC batch",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
+        self.plane_tenant_submit_seconds = Histogram(
+            "tpu_plane_tenant_submit_seconds",
+            "Admission-to-result wall seconds per tenant submission "
+            "through the crypto-plane service",
+            labels + ["tenant"],
+            registry=self.registry,
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.5, 2.0, 10.0, 60.0),
+        )
         # duty-rooted tracing (ISSUE 4): per-step latency from span
         # ends plus the slow-duty detector's wall-time/budget verdicts
         self.step_latency = Histogram(
@@ -363,6 +424,42 @@ class ClusterMetrics:
                 else self.wire_decode_seconds
             )
             self.labels(hist, codec_name).observe(max(0.0, seconds))
+
+        return hook
+
+    def tenant_hook(self):
+        """CryptoPlaneService.observer sink: typed service events ->
+        the tenant-labeled metric families. Runs on the event loop;
+        prometheus client objects are thread-safe anyway."""
+        state_value = {"closed": 0, "half_open": 1, "open": 2}
+
+        def hook(kind: str, tenant: str, **f) -> None:
+            if kind == "shed":
+                self.labels(self.plane_tenant_shed, tenant, f["reason"]).inc()
+            elif kind == "queue":
+                self.labels(self.plane_tenant_queue, tenant).set(f["lanes"])
+            elif kind == "breaker":
+                self.labels(self.plane_tenant_breaker, tenant).set(
+                    state_value.get(f["state"], 0)
+                )
+                self.labels(
+                    self.plane_tenant_breaker_transitions, tenant, f["state"]
+                ).inc()
+            elif kind == "complete":
+                self.labels(self.plane_tenant_submit_seconds, tenant).observe(
+                    max(0.0, f["seconds"])
+                )
+                if f.get("quarantined"):
+                    self.labels(self.plane_tenant_quarantined, tenant).inc()
+
+        return hook
+
+    def peer_quarantine_hook(self):
+        """P2PNode.quarantine_observer sink: count imposed peer mutes
+        by peer index."""
+
+        def hook(peer_idx: int, mute_seconds: float) -> None:
+            self.labels(self.wire_peer_quarantine, str(peer_idx)).inc()
 
         return hook
 

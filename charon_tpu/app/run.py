@@ -107,6 +107,17 @@ class Config:
     # app/faultinject + testutil/chaos). "" keeps the plane inert: no
     # wrapper objects are constructed on the un-instrumented path.
     fault_injection: str = ""
+    # multi-tenant crypto-plane service (ISSUE 8, core/cryptosvc): the
+    # node registers its cluster as a tenant of the (possibly shared)
+    # device plane; quotas bound the damage any one tenant can do to
+    # the others. "" = tenant id defaults to the cluster name.
+    crypto_tenant: str = ""
+    crypto_tenant_weight: float = 1.0  # share of the per-round budget
+    crypto_tenant_queue_jobs: int = 256  # admission bound (submissions)
+    crypto_tenant_queue_lanes: int = 4096  # admission bound (lanes)
+    crypto_plane_round_lanes: int = 4096  # total admission per round
+    crypto_breaker_threshold: float = 0.5  # failed ratio that opens
+    crypto_breaker_cooldown: float = 5.0  # seconds open -> half-open
 
 
 @dataclass
@@ -126,6 +137,7 @@ class Node:
     beacon: object
     sigagg: SigAgg | None = None
     crypto_plane: object | None = None  # core.cryptoplane.SlotCoalescer
+    crypto_svc: object | None = None  # core.cryptosvc.CryptoPlaneService
     inclusion: InclusionChecker | None = None
 
     async def rewarm_point_caches(
@@ -219,6 +231,8 @@ async def build_node(config: Config) -> Node:
         faultinject.init_from_env()
 
     crypto_plane = None
+    crypto_svc = None
+    tenant_plane = None  # the handle components hold (core/cryptosvc)
     if config.use_tpu_tbls:
         from charon_tpu.tbls.tpu_impl import TPUImpl
 
@@ -360,6 +374,12 @@ async def build_node(config: Config) -> Node:
             metrics.labels(metrics.plane_decode_mode).set(
                 1 if s.decode_mode == "device" else 0
             )
+            # per-tenant flush attribution (ISSUE 8)
+            for tenant, lanes in s.tenant_lanes:
+                if lanes:
+                    metrics.labels(
+                        metrics.plane_tenant_lanes, tenant
+                    ).inc(lanes)
 
         # bridge each flush's decode/pack/device stages into tracer
         # spans joined to the duty traces that rode the flush (ISSUE 4
@@ -370,6 +390,40 @@ async def build_node(config: Config) -> Node:
         # bulk warm-up passes (startup + rotation) land in the
         # cold-start metric families (ISSUE 6)
         crypto_plane.warmup_hook = metrics.observe_warmup
+
+        # multi-tenant service boundary (ISSUE 8): components below
+        # hold a TenantPlane handle, never the raw coalescer — the
+        # service adds admission control, deadline-aware fair
+        # scheduling and the per-tenant forged-flood breaker in front
+        # of the shared coalescing window
+        from charon_tpu.core.cryptosvc import (
+            CryptoPlaneService,
+            TenantQuota,
+        )
+
+        tenant_id = config.crypto_tenant or lock.definition.name
+        crypto_svc = CryptoPlaneService(
+            crypto_plane,
+            round_lanes=config.crypto_plane_round_lanes,
+            observer=metrics.tenant_hook(),
+        )
+        tenant_plane = crypto_svc.register(
+            tenant_id,
+            TenantQuota(
+                weight=config.crypto_tenant_weight,
+                max_queue_jobs=config.crypto_tenant_queue_jobs,
+                max_queue_lanes=config.crypto_tenant_queue_lanes,
+                breaker_threshold=config.crypto_breaker_threshold,
+                breaker_cooldown=config.crypto_breaker_cooldown,
+            ),
+        )
+        log.info(
+            "crypto plane tenant registered",
+            topic="app",
+            tenant=tenant_id,
+            queue_lanes=config.crypto_tenant_queue_lanes,
+            round_lanes=config.crypto_plane_round_lanes,
+        )
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -478,6 +532,8 @@ async def build_node(config: Config) -> Node:
         # wire codec observability (ISSUE 7): per-frame encode/decode
         # seconds + byte volume by codec (binary vs json fallback)
         p2p_node.wire_observer = metrics.wire_hook()
+        # per-peer codec quarantine mutes (ISSUE 8 satellite)
+        p2p_node.quarantine_observer = metrics.peer_quarantine_hook()
         await p2p_node.start()
         # frame-level faults on the live mesh (inert no-op by default)
         faultinject.maybe_wrap_p2p_node(p2p_node)
@@ -511,9 +567,9 @@ async def build_node(config: Config) -> Node:
         threshold=t,
         fork=fork,
         slots_per_epoch=config.slots_per_epoch,
-        plane=crypto_plane,
-        pubshares_by_idx=pubshares_by_idx if crypto_plane else None,
-        clock=clock if crypto_plane else None,
+        plane=tenant_plane,
+        pubshares_by_idx=pubshares_by_idx if tenant_plane else None,
+        clock=clock if tenant_plane else None,
     )
     # impl selected by the AGG_SIG_DB_V2 feature flag (ref: app wiring
     # gates memory_v2 behind the alpha flag)
@@ -551,14 +607,14 @@ async def build_node(config: Config) -> Node:
         pubshares=pubshares_by_idx[share_idx],
         fork=fork,
         slots_per_epoch=config.slots_per_epoch,
-        plane=crypto_plane,
+        plane=tenant_plane,
     )
     verifier = Eth2Verifier(
         fork,
         pubshares_by_idx,
         config.slots_per_epoch,
-        plane=crypto_plane,
-        clock=clock if crypto_plane else None,
+        plane=tenant_plane,
+        clock=clock if tenant_plane else None,
     )
     parsigex = ParSigEx(
         share_idx, parsig_transport, verifier, gater=duty_gater
@@ -830,6 +886,10 @@ async def build_node(config: Config) -> Node:
             )
 
         async def stop_plane():
+            if crypto_svc is not None:
+                # service first: fail queued waiters fast and close the
+                # per-tenant quarantine coalescers before the shared one
+                crypto_svc.close()
             crypto_plane.close()
 
         life.register_stop(Order.SCHEDULER, "crypto-plane", stop_plane)
@@ -1014,6 +1074,7 @@ async def build_node(config: Config) -> Node:
         beacon=beacon,
         sigagg=sigagg,
         crypto_plane=crypto_plane,
+        crypto_svc=crypto_svc,
         inclusion=inclusion,
     )
 

@@ -479,6 +479,12 @@ def plane_span_bridge(tracer: Tracer | None = None, inner_hook=None):
         if stats.padded_lanes:
             flush_attrs["bucket"] = stats.padded_lanes
             flush_attrs["pad_lanes"] = stats.pad_lanes
+        tenant_lanes = getattr(stats, "tenant_lanes", ()) or ()
+        if tenant_lanes:
+            # multi-tenant service (core/cryptosvc): name every tenant
+            # whose lanes rode this flush, so a duty timeline shows WHO
+            # shared the device window with it
+            flush_attrs["tenants"] = ",".join(t for t, _ in tenant_lanes)
         for i, (trace_id, parent_id) in enumerate(parents):
             # one flush -> one record per participating duty trace: mark
             # the copies beyond the first so metric hooks (span_metrics)

@@ -179,6 +179,55 @@ def build_parser() -> argparse.ArgumentParser:
         "warm-up')",
     )
     runp.add_argument(
+        "--crypto-tenant",
+        default=_env_default("crypto-tenant", ""),
+        help="tenant id this node registers with the multi-tenant "
+        "crypto-plane service (core/cryptosvc.py); default = the "
+        "cluster name (docs/operations.md 'Multi-tenant deployment')",
+    )
+    runp.add_argument(
+        "--crypto-tenant-weight",
+        type=float,
+        default=float(_env_default("crypto-tenant-weight", 1.0)),
+        help="this tenant's relative share of the per-round lane "
+        "budget (weighted-fair scheduling across tenants)",
+    )
+    runp.add_argument(
+        "--crypto-tenant-queue-lanes",
+        type=int,
+        default=int(_env_default("crypto-tenant-queue-lanes", 4096)),
+        help="per-tenant admission bound: pending lanes beyond this "
+        "shed with PlaneOverloadError onto the submitter's host rung",
+    )
+    runp.add_argument(
+        "--crypto-tenant-queue-jobs",
+        type=int,
+        default=int(_env_default("crypto-tenant-queue-jobs", 256)),
+        help="per-tenant admission bound on pending submissions "
+        "(the jobs twin of --crypto-tenant-queue-lanes)",
+    )
+    runp.add_argument(
+        "--crypto-plane-round-lanes",
+        type=int,
+        default=int(_env_default("crypto-plane-round-lanes", 4096)),
+        help="total lanes the service admits per scheduling round "
+        "across all tenants (split weight-proportionally)",
+    )
+    runp.add_argument(
+        "--crypto-breaker-threshold",
+        type=float,
+        default=float(_env_default("crypto-breaker-threshold", 0.5)),
+        help="failed-verification lane ratio that opens the tenant's "
+        "circuit breaker (forged-flood quarantine to its own flushes)",
+    )
+    runp.add_argument(
+        "--crypto-breaker-cooldown",
+        type=float,
+        default=float(_env_default("crypto-breaker-cooldown", 5.0)),
+        help="seconds an open breaker waits before half-opening (one "
+        "clean quarantined flush then closes it)",
+    )
+    runp.add_argument(
         "--relay",
         default=_env_default("relay", ""),
         help="host:port of a charon-tpu relay for NAT fallback dials",
@@ -546,6 +595,13 @@ def cmd_run(args) -> int:
         crypto_plane_prewarm=args.crypto_plane_prewarm,
         crypto_plane_decode=args.crypto_plane_decode,
         crypto_plane_warmup=args.crypto_plane_warmup,
+        crypto_tenant=args.crypto_tenant,
+        crypto_tenant_weight=args.crypto_tenant_weight,
+        crypto_tenant_queue_lanes=args.crypto_tenant_queue_lanes,
+        crypto_tenant_queue_jobs=args.crypto_tenant_queue_jobs,
+        crypto_plane_round_lanes=args.crypto_plane_round_lanes,
+        crypto_breaker_threshold=args.crypto_breaker_threshold,
+        crypto_breaker_cooldown=args.crypto_breaker_cooldown,
         tracing_endpoint=args.tracing_endpoint,
         tracing_jsonl=args.tracing_jsonl,
         relay_addr=args.relay,

@@ -98,6 +98,7 @@ class _VerifyJob:
     decode_delays: tuple = ()  # decode-pool queue delay per chunk
     decode_spans: tuple = ()  # wall-clock (start, end) per decode chunk
     parent: tuple | None = None  # submitter's (trace_id, span_id)
+    tenant: str | None = None  # submitting tenant (core/cryptosvc)
 
 
 @dataclass
@@ -113,6 +114,7 @@ class _RecombineJob:
     decode_delays: tuple = ()
     decode_spans: tuple = ()
     parent: tuple | None = None
+    tenant: str | None = None
 
 
 @dataclass(frozen=True)
@@ -151,6 +153,11 @@ class FlushStats:
     device_span: tuple[float, float] | None = None
     # (trace_id, span_id) captured from each submission's active span
     parents: tuple[tuple[str, str], ...] = ()
+    # live lanes per submitting tenant (ISSUE 8): (tenant_id, lanes)
+    # pairs for the jobs that carried a tenant tag — the per-flush
+    # attribution the tenant-labeled metric families and the span
+    # bridge's tenant attrs are built from
+    tenant_lanes: tuple[tuple[str, int], ...] = ()
 
 
 def _decode_pubkey(pk: bytes):
@@ -292,6 +299,7 @@ class SlotCoalescer:
         self._flush_at: float = 0.0  # monotonic flush target of armed task
         self._flush_wake = asyncio.Event()
         self._queue_deadline: float | None = None  # monotonic, min over jobs
+        self._wall_offset = 0.0  # wall->monotonic, snapshotted per window
         # submissions mid-decode (closing windows wait for these)
         self._decode_tickets: set[asyncio.Future] = set()
         self._window_current = window
@@ -448,11 +456,14 @@ class SlotCoalescer:
         self,
         items: Sequence[tuple[bytes, bytes, bytes]],
         deadline: float | None = None,
+        tenant: str | None = None,
     ) -> list[bool]:
         """Batch-verify (pubkey_bytes, signing_root, sig_bytes) lanes.
         Returns per-lane validity; malformed encodings are False.
         deadline: optional absolute wall-clock (time.time) duty deadline
-        — pulls the flush earlier when the window would overshoot it."""
+        — pulls the flush earlier when the window would overshoot it.
+        tenant: optional tenant id (core/cryptosvc) for per-flush
+        attribution in FlushStats/metrics/span attrs."""
         if not items:
             return []
         loop = asyncio.get_running_loop()
@@ -477,6 +488,7 @@ class SlotCoalescer:
                 decode_delays=delays,
                 decode_spans=spans,
                 parent=self._submit_ctx(),
+                tenant=tenant,
             )
             self._verify_q.append(job)
             self._arm(deadline)
@@ -497,6 +509,7 @@ class SlotCoalescer:
         group_pks: Sequence[bytes],
         indices: Sequence[Sequence[int]],
         deadline: float | None = None,
+        tenant: str | None = None,
     ) -> tuple[list[bytes | None], list[bool]]:
         """Threshold-recombine + verify a duty's [V, t] workload.
         Returns ([V] group signature bytes or None, [V] ok flags)."""
@@ -559,6 +572,7 @@ class SlotCoalescer:
                 decode_delays=delays,
                 decode_spans=spans,
                 parent=self._submit_ctx(),
+                tenant=tenant,
             )
             self._recombine_q.append(job)
             self._arm(deadline)
@@ -579,10 +593,17 @@ class SlotCoalescer:
 
     def _arm(self, deadline: float | None = None) -> None:
         now = time.monotonic()
+        new_window = self._flush_task is None or self._flush_task.done()
+        if new_window:
+            # duty deadlines are wall-clock (core/deadline.SlotClock)
+            # but the flush timer runs on the monotonic base — snapshot
+            # the wall->monotonic offset ONCE per window. Converting per
+            # call meant a host clock step mid-window (chaos clock-skew)
+            # translated later submissions' deadlines inconsistently,
+            # wrongly collapsing or stretching the armed window.
+            self._wall_offset = now - time.time()
         if deadline is not None:
-            # duty deadlines are wall-clock (core/deadline.SlotClock);
-            # convert to the monotonic base the flush timer runs on
-            dl_mono = now + max(0.0, deadline - time.time())
+            dl_mono = max(now, deadline + self._wall_offset)
             if self._queue_deadline is None or dl_mono < self._queue_deadline:
                 self._queue_deadline = dl_mono
         target = now + self._window_current
@@ -594,7 +615,7 @@ class SlotCoalescer:
                 self.window_min, remaining * self.DEADLINE_WINDOW_FRAC
             )
             target = min(target, now + cap)
-        if self._flush_task is None or self._flush_task.done():
+        if new_window:
             self._flush_at = target
             # fresh Event per armed task: asyncio primitives bind to the
             # running loop on first use, and one coalescer may serve
@@ -989,6 +1010,7 @@ class SlotCoalescer:
                 pack_span=pack_span,
                 device_span=(w0, time.time()),
                 parents=self._job_parents(vq, rq),
+                tenant_lanes=self._job_tenant_lanes(vq, rq),
             ),
         )
         return vres, rres
@@ -1058,6 +1080,24 @@ class SlotCoalescer:
         return tuple(
             job.parent for job in [*vq, *rq] if job.parent is not None
         )
+
+    @staticmethod
+    def _job_tenant_lanes(vq, rq) -> tuple:
+        """Live lanes per submitting tenant (ISSUE 8). Untagged jobs
+        (single-tenant deployments bypassing the service) contribute
+        nothing — the aggregate counters already cover them."""
+        per: dict[str, int] = {}
+        for job in vq:
+            if job.tenant is not None:
+                per[job.tenant] = per.get(job.tenant, 0) + sum(
+                    1 for lane in job.lanes if lane is not None
+                )
+        for job in rq:
+            if job.tenant is not None:
+                per[job.tenant] = per.get(job.tenant, 0) + sum(
+                    1 for pf in job.prefail if not pf
+                )
+        return tuple(sorted(per.items()))
 
     def _account_flush(self, vq, rq, lanes: int, stats: FlushStats) -> None:
         self.lanes_flushed += lanes
@@ -1404,6 +1444,7 @@ class SlotCoalescer:
                 decode_spans=self._job_decode_spans(vq, rq),
                 device_span=(w0, time.time()),
                 parents=self._job_parents(vq, rq),
+                tenant_lanes=self._job_tenant_lanes(vq, rq),
             ),
         )
         return vres, rres

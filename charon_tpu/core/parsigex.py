@@ -10,11 +10,13 @@ component via the p2p layer.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from collections import defaultdict
 from typing import Awaitable, Callable
 
 from charon_tpu import tbls
+from charon_tpu.core.cryptosvc import PlaneOverloadError
 from charon_tpu.core.deadline import LATE_FACTOR, SlotClock
 from charon_tpu.core.eth2data import ParSignedData
 from charon_tpu.core.types import Duty, DutyType, PubKey
@@ -123,7 +125,18 @@ class Eth2Verifier:
             # near-deadline sets shrink the coalescing window instead of
             # waiting out a load-grown one (core/cryptoplane adaptive)
             kwargs["deadline"] = self.clock.duty_deadline(duty)
-        return all(await self.plane.verify(items, **kwargs))
+        try:
+            return all(await self.plane.verify(items, **kwargs))
+        except PlaneOverloadError:
+            # admission shed (core/cryptosvc backpressure): serve THIS
+            # set from the host tbls rung — on an executor thread, so
+            # shed load costs latency on the degraded path, never a
+            # dropped inbound set or a blocked event loop (host BLS is
+            # ~0.3 s/verify on the python rung)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, self.verify, duty, signed_set
+            )
 
 
 class MemTransport:

@@ -9,6 +9,7 @@ the tbls batch API, instead of the reference's per-pubkey herumi calls.
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Mapping
 
@@ -135,9 +136,24 @@ class SigAgg:
         kwargs = {}
         if self.clock is not None:
             kwargs["deadline"] = self.clock.duty_deadline(duty)
-        group_sigs, ok = await self.plane.recombine(
-            ps_rows, roots, sig_rows, gpks, idx_rows, **kwargs
-        )
+        from charon_tpu.core.cryptosvc import PlaneOverloadError
+
+        try:
+            group_sigs, ok = await self.plane.recombine(
+                ps_rows, roots, sig_rows, gpks, idx_rows, **kwargs
+            )
+        except PlaneOverloadError:
+            # admission shed (core/cryptosvc backpressure): recombine
+            # THIS duty on the host tbls rung, on an executor thread —
+            # the aggregation ladder absorbs shed load instead of
+            # failing the duty, and the host pairing math never stalls
+            # the event loop
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None,
+                self._aggregate_via_tbls,
+                epoch, pubkeys, partial_maps, templates,
+            )
         bad = [str(pk) for pk, o in zip(pubkeys, ok) if not o]
         if bad:
             raise AggregationError(

@@ -255,7 +255,19 @@ class ValidatorAPI:
         crypto plane installed, concurrent submissions coalesce into one
         sharded device program."""
         if self.plane is not None:
-            ok = await self.plane.verify(items)
+            import asyncio
+
+            from charon_tpu.core.cryptosvc import PlaneOverloadError
+
+            try:
+                ok = await self.plane.verify(items)
+            except PlaneOverloadError:
+                # admission shed (core/cryptosvc backpressure): this
+                # VC's submission verifies on the host tbls rung, off
+                # the event loop (host BLS would stall it for seconds)
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, tbls.verify_batch, items
+                )
         else:
             ok = tbls.verify_batch(items)
         if not all(ok):

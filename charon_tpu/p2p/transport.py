@@ -41,12 +41,25 @@ from typing import Awaitable, Callable
 
 from charon_tpu.app import k1util, log
 from charon_tpu.app.errors import StructuredError
-from charon_tpu.p2p import codec
+from charon_tpu.p2p import codec, quarantine
 
 MAX_FRAME = 128 * 1024 * 1024  # ref: p2p/sender.go:26
 SEND_TIMEOUT = 7.0  # ref: p2p/sender.go:28
 RECV_TIMEOUT = 5.0  # ref: p2p/sender.go:27
 HYSTERESIS_FAILS = 3  # suppress errors after this many consecutive fails
+# Per-peer codec quarantine (ISSUE 8 satellite): dropping-and-counting
+# malformed frames keeps the conn alive, but a peer STREAMING garbage
+# (buggy build, fuzzing adversary) still costs a decode attempt + a log
+# line per frame. After QUARANTINE_STRIKES CodecErrors inside
+# QUARANTINE_WINDOW seconds the peer is temporarily muted — its frames
+# drop before decode — for QUARANTINE_BASE seconds, doubling per repeat
+# offense up to QUARANTINE_MAX; a clean frame after the mute expires
+# forgives the backoff level. (State machine: p2p/quarantine.py —
+# cryptography-free so the fast tier exercises it everywhere.)
+QUARANTINE_STRIKES = quarantine.QUARANTINE_STRIKES
+QUARANTINE_WINDOW = quarantine.QUARANTINE_WINDOW
+QUARANTINE_BASE = quarantine.QUARANTINE_BASE
+QUARANTINE_MAX = quarantine.QUARANTINE_MAX
 # Highest binary wire format this build speaks (0 = JSON only). The
 # handshake advertises it; each connection sends min(ours, theirs).
 WIRE_VERSION = 1
@@ -146,6 +159,18 @@ class P2PNode:
         self._recv_tasks: set[asyncio.Task] = set()
         # per-frame typed drops (codec.CodecError on a live connection)
         self.codec_dropped = 0
+        # per-peer codec quarantine (see QUARANTINE_* above); module
+        # constants are read at construction so tests can shrink them
+        self._quarantine = quarantine.PeerQuarantine(
+            strikes=QUARANTINE_STRIKES,
+            window=QUARANTINE_WINDOW,
+            base=QUARANTINE_BASE,
+            max_mute=QUARANTINE_MAX,
+            observer=self._on_quarantine,
+        )
+        self.quarantined_frames = 0  # frames dropped undecoded while muted
+        # optional quarantine sink: called with (peer_idx, mute_seconds)
+        self.quarantine_observer: Callable | None = None
         # optional wire metrics sink: called with (direction "tx"|"rx",
         # codec "binary"|"json", frame_bytes, codec_seconds). Must be
         # cheap and thread-safe (app/metrics.ClusterMetrics.wire_hook).
@@ -486,16 +511,47 @@ class P2PNode:
         )
         return env
 
+    @property
+    def peer_quarantines(self) -> int:
+        """Mutes imposed so far (wire_peer_quarantine_total)."""
+        return self._quarantine.quarantines
+
+    def peer_quarantined(self, peer_idx: int) -> bool:
+        return self._quarantine.muted(peer_idx)
+
+    def _on_quarantine(self, peer_idx: int, mute: float) -> None:
+        log.warn(
+            "quarantining peer after repeated malformed frames",
+            topic="p2p",
+            peer=peer_idx,
+            mute_seconds=mute,
+            strikes=self._quarantine.strikes,
+        )
+        if self.quarantine_observer is not None:
+            self.quarantine_observer(peer_idx, mute)
+
     async def _recv_loop(self, conn: _Conn) -> None:
         try:
             while True:
                 frame = await _read_sframe(conn)
+                if self._quarantine.any_history and self._quarantine.muted(
+                    conn.peer_idx
+                ):
+                    # muted peer: drop before decode — a garbage stream
+                    # costs a counter bump, not a decode attempt + log
+                    # line per frame
+                    self.quarantined_frames += 1
+                    continue
                 # Per-frame fault isolation: a malformed payload or a
                 # handler bug drops THAT frame, not the authenticated
                 # connection carrying live consensus traffic (frame
                 # integrity itself is the MAC's job in _read_sframe).
                 try:
                     env = self._decode_envelope(frame)
+                    if self._quarantine.any_history:
+                        # a clean frame after the mute expired forgives
+                        # the peer's exponential-backoff level
+                        self._quarantine.forgive(conn.peer_idx)
                     if env["k"] == "rsp":
                         fut = self._pending.pop(env["id"], None)
                         if fut is not None and not fut.done():
@@ -518,6 +574,7 @@ class P2PNode:
                     # — fails the MAC instead and tears down the conn
                     # by design; see _read_sframe.)
                     self.codec_dropped += 1
+                    self._quarantine.strike(conn.peer_idx)
                     log.warn(
                         "dropping malformed frame",
                         topic="p2p",

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time as _time_mod
 from dataclasses import dataclass, field, replace as _dc_replace
 
 
@@ -471,6 +472,61 @@ class FlakyBackend:
             return inner(*args, **kwargs)
 
         return call
+
+
+# -- host clock skew ---------------------------------------------------------
+
+
+class SkewedClock:
+    """Steppable wall clock for chaos clock-skew scenarios: installed
+    as a context manager it replaces `time.time` with real time plus a
+    controllable offset, while `time.monotonic` stays untouched —
+    exactly the asymmetry a real host clock step (NTP correction, VM
+    migration, operator fat-finger) produces. Code converting between
+    the two bases (e.g. SlotCoalescer._arm's duty deadlines) sees the
+    bases disagree mid-run, which is the bug class this injector
+    exists to reproduce deterministically.
+
+        with SkewedClock() as clock:
+            ...  # wall clock normal
+            clock.step(60.0)   # host clock jumps forward a minute
+            ...  # wall clock now leads monotonic by 60 s
+    """
+
+    def __init__(self, offset: float = 0.0) -> None:
+        self.offset = offset
+        self._real = _time_mod.time
+
+    def __call__(self) -> float:
+        return self._real() + self.offset
+
+    def step(self, seconds: float) -> None:
+        """Step the wall clock by `seconds` (negative = backward)."""
+        self.offset += seconds
+
+    def __enter__(self) -> "SkewedClock":
+        _time_mod.time = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _time_mod.time = self._real
+
+
+# -- forged-signature floods -------------------------------------------------
+
+
+def forged_signatures(n: int, rng: random.Random) -> list[bytes]:
+    """n seeded 96-byte G2 'signatures' with plausible compression
+    flags (compressed bit set, infinity bit clear) but garbage field
+    bytes: they pass the cheap flag checks and then fail decompression
+    or verification — the forged-flood payload a byzantine tenant
+    pours into a shared crypto plane."""
+    out = []
+    for _ in range(n):
+        b = bytearray(rng.randbytes(96))
+        b[0] = 0x80 | (0x20 if rng.random() < 0.5 else 0) | (b[0] & 0x1F)
+        out.append(bytes(b))
+    return out
 
 
 # -- raw p2p frame chaos (absorbs the old p2p/fuzz.py) -----------------------

@@ -50,16 +50,19 @@ case "$TIER" in
     exec python obs_check.py --fast
     ;;
   hostplane)
-    # Wall-clock budget: ~45 s. Tiny shapes, CPU, no jax: asserts the
+    # Wall-clock budget: ~60 s. Tiny shapes, CPU, no jax: asserts the
     # coalescer's decode pool keeps event-loop stall >= 3x below the
     # synchronous path, that double-buffered flushes overlap host
     # decode with the in-flight device program, that the device
     # decode rung's host-side parse beats the python bigint decode by
     # >= 5x host CPU per burst (bench_hostplane.py, ISSUE 5), that
     # the cold-start hash-to-curve A/B holds its >= 5x host-CPU cut
-    # (ISSUE 6), and that the wire-path codec + bytes->limb A/Bs hold
-    # their >= 5x cuts (bench_wire.py, ISSUE 7).
+    # (ISSUE 6), that the wire-path codec + bytes->limb A/Bs hold
+    # their >= 5x cuts (bench_wire.py, ISSUE 7), and that a flooding
+    # tenant degrades a victim tenant's p99 flush latency < 2x while
+    # its own overload sheds (core/cryptosvc, ISSUE 8).
     python bench_hostplane.py --smoke --cold-start
+    python bench_hostplane.py --tenants
     exec python bench_wire.py --smoke
     ;;
   slow)
@@ -89,11 +92,17 @@ case "$TIER" in
     exec python obs_check.py
     ;;
   chaos)
-    # Wall-clock budget: ~2 min unloaded. The 8 seeded fault scenarios
+    # Wall-clock budget: ~3 min unloaded. The 8 seeded fault scenarios
     # (silenced node, partition+heal, flappy beacon, crash-recover,
     # crypto-backend loss, round-change storm, hedged dispatch,
-    # corrupt/duplicate frames) plus retry/backoff edge tests.
-    exec "${PYTEST[@]}" tests/test_chaos_scenarios.py tests/test_retry_backoff.py
+    # corrupt/duplicate frames) plus the 3 multi-tenant isolation
+    # scenarios (ISSUE 8: forged flood + crash-loop, queue flood,
+    # clock-skewed deadlines — tenant B misses ZERO duties), the
+    # retry/backoff edge tests, and the multi-tenant A/B gate (a
+    # flooding tenant degrades the victim's p99 < 2x while its own
+    # over-budget load sheds).
+    "${PYTEST[@]}" tests/test_chaos_scenarios.py tests/test_retry_backoff.py
+    exec python bench_hostplane.py --tenants
     ;;
   *)
     echo "usage: $0 [fast|slow|full|chaos|hostplane|obs]" >&2

@@ -488,3 +488,234 @@ def test_chaos_corrupt_duplicate_parsig_frames():
         assert not report.failed_pubkeys
 
     asyncio.run(run())
+
+
+# -- 9-11. multi-tenant crypto-plane isolation (ISSUE 8) ---------------------
+#
+# N independent DV clusters share one device mesh through the
+# core/cryptosvc service boundary. Each scenario runs two tenants over
+# one REAL SlotCoalescer (device = the counting FakePlane; forged lanes
+# fail host decode exactly as they would in production) and asserts the
+# tentpole promise: tenant A's abuse — forged-signature flood,
+# crash-loop, queue flood, clock-skewed deadlines — costs tenant B
+# ZERO duties, and the shed/breaker/quarantine counters attribute the
+# damage to tenant A only.
+
+from charon_tpu.core.cryptosvc import (  # noqa: E402
+    CryptoPlaneService,
+    PlaneOverloadError,
+    TenantQuota,
+)
+from charon_tpu.testutil.chaos import SkewedClock, forged_signatures  # noqa: E402
+from tests.test_cryptoplane import FakePlane, T  # noqa: E402
+
+
+def _valid_items(n: int = 4):
+    impl = PythonImpl()
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    root = b"\x42" * 32
+    sig = impl.sign(sk, root)
+    return [(pk, root, sig)] * n
+
+
+class _SharedMesh:
+    """Two tenants over one real coalescer + service."""
+
+    def __init__(self, breaker_cooldown: float = 0.3,
+                 victim_quota: TenantQuota | None = None,
+                 abuser_quota: TenantQuota | None = None):
+        from charon_tpu.core.cryptoplane import SlotCoalescer
+
+        self.fake = FakePlane(T)
+        self.coal = SlotCoalescer(self.fake, window=0.01, decode_workers=2)
+        self.svc = CryptoPlaneService(
+            self.coal, round_lanes=64, round_interval=0.01
+        )
+        self.victim = self.svc.register(
+            "tenant-b", victim_quota or TenantQuota()
+        )
+        self.abuser = self.svc.register(
+            "tenant-a",
+            abuser_quota
+            or TenantQuota(
+                breaker_window=64,
+                breaker_min_lanes=16,
+                breaker_threshold=0.5,
+                breaker_cooldown=breaker_cooldown,
+            ),
+        )
+
+    def close(self):
+        self.svc.close()
+        self.coal.close()
+
+    def assert_damage_attributed_to_abuser_only(self):
+        b = self.svc.tenant("tenant-b")
+        assert b.breaker.state == "closed" and not b.breaker.transitions
+        assert b.shed == {} and b.shed_lanes == 0
+        assert b.quarantined_flushes == 0 and b.failed_lanes == 0
+
+
+async def _run_victim_duties(
+    plane, items, duties: int = 12, period: float = 0.03,
+    budget: float = 2.0,
+) -> int:
+    """Tenant B's duty loop: paced verify bursts, each with a wall
+    deadline AND a hard await budget. Returns duties missed."""
+    missed = 0
+    for _ in range(duties):
+        t0 = time.monotonic()
+        try:
+            res = await asyncio.wait_for(
+                plane.verify(list(items), deadline=time.time() + budget),
+                timeout=budget,
+            )
+            ok = all(res) and (time.monotonic() - t0) <= budget
+        except Exception:  # noqa: BLE001 — any failure = a missed duty
+            ok = False
+        if not ok:
+            missed += 1
+        await asyncio.sleep(period)
+    return missed
+
+
+def test_chaos_tenant_forged_flood_and_crash_loop():
+    """THE acceptance scenario: tenant A pours forged-signature bursts
+    into the shared plane while crash-looping (cancelling its own
+    in-flight submissions); tenant B completes 100% of duties within
+    deadline, A's breaker opens and quarantines it to its own flushes,
+    and every damage counter names A."""
+
+    async def run():
+        mesh = _SharedMesh()
+        rng = ChaosConfig(seed=SEED).stream("tenant:forged")
+        items = _valid_items(4)
+        pk, root, _sig = items[0]
+        stop = asyncio.Event()
+
+        async def one_burst():
+            forged = [(pk, root, s) for s in forged_signatures(10, rng)]
+            try:
+                await mesh.abuser.verify(
+                    forged, deadline=time.time() + 2.0
+                )
+            except PlaneOverloadError:
+                pass
+
+        async def crash_looping_flood():
+            while not stop.is_set():
+                task = asyncio.create_task(one_burst())
+                await asyncio.sleep(rng.uniform(0.0, 0.01))
+                if rng.random() < 0.5:
+                    task.cancel()  # tenant A's node crashes mid-flight
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                await asyncio.sleep(rng.uniform(0.0, 0.005))
+
+        flood = asyncio.create_task(crash_looping_flood())
+        try:
+            missed = await _run_victim_duties(mesh.victim, items)
+        finally:
+            stop.set()
+            await flood
+        a = mesh.svc.tenant("tenant-a")
+        assert missed == 0, f"tenant B missed {missed} duties"
+        assert a.breaker.transitions.get("open", 0) >= 1
+        assert a.quarantined_flushes > 0, "open breaker must quarantine A"
+        assert a.failed_lanes > 0
+        mesh.assert_damage_attributed_to_abuser_only()
+        mesh.close()
+
+    asyncio.run(run())
+
+
+def test_chaos_tenant_queue_flood_sheds_only_flooder():
+    """Tenant A floods the admission queue far over its lane bound:
+    over-budget submissions shed fast with PlaneOverloadError (the
+    flood never reaches the shared window), tenant B misses nothing,
+    and only A's shed counters move."""
+
+    async def run():
+        mesh = _SharedMesh(
+            abuser_quota=TenantQuota(
+                max_queue_jobs=8, max_queue_lanes=64
+            ),
+        )
+        rng = ChaosConfig(seed=SEED).stream("tenant:queueflood")
+        items = _valid_items(4)
+        stop = asyncio.Event()
+
+        async def queue_flood():
+            # fire-and-forget bursts WAY over quota, never awaiting
+            # completion before the next — the classic queue flood
+            pending: set[asyncio.Task] = set()
+            while not stop.is_set():
+                for _ in range(8):
+
+                    async def burst():
+                        try:
+                            await mesh.abuser.verify(list(items) * 4)
+                        except PlaneOverloadError:
+                            pass
+
+                    task = asyncio.create_task(burst())
+                    pending.add(task)
+                    task.add_done_callback(pending.discard)
+                await asyncio.sleep(rng.uniform(0.001, 0.005))
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+        flood = asyncio.create_task(queue_flood())
+        try:
+            missed = await _run_victim_duties(mesh.victim, items)
+        finally:
+            stop.set()
+            await flood
+        a = mesh.svc.tenant("tenant-a")
+        assert missed == 0, f"tenant B missed {missed} duties"
+        assert sum(a.shed.values()) > 0, "the flood must have shed"
+        mesh.assert_damage_attributed_to_abuser_only()
+        mesh.close()
+
+    asyncio.run(run())
+
+
+def test_chaos_tenant_clock_skewed_deadlines():
+    """The host wall clock steps forward and backward (NTP correction,
+    VM migration) while both tenants submit deadline-carrying work: the
+    coalescer's per-window offset snapshot (the ISSUE 8 bugfix) keeps
+    coalescing windows sane and tenant B misses zero duties."""
+
+    async def run():
+        mesh = _SharedMesh()
+        rng = ChaosConfig(seed=SEED).stream("tenant:skew")
+        items = _valid_items(4)
+        stop = asyncio.Event()
+
+        with SkewedClock() as clock:
+
+            async def skewing_flood():
+                while not stop.is_set():
+                    clock.step(rng.uniform(-90.0, 90.0))
+                    try:
+                        await mesh.abuser.verify(
+                            list(items), deadline=time.time() + 2.0
+                        )
+                    except PlaneOverloadError:
+                        pass
+                    await asyncio.sleep(rng.uniform(0.0, 0.01))
+
+            flood = asyncio.create_task(skewing_flood())
+            try:
+                missed = await _run_victim_duties(mesh.victim, items)
+            finally:
+                stop.set()
+                await flood
+        assert missed == 0, f"tenant B missed {missed} duties"
+        mesh.assert_damage_attributed_to_abuser_only()
+        mesh.close()
+
+    asyncio.run(run())

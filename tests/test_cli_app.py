@@ -113,9 +113,10 @@ def test_app_run_single_node_simnet(tmp_path):
 
 def test_app_wires_crypto_plane_on_multidevice(tmp_path):
     """build_node with the TPU backend on a multi-device backend (the
-    8-device virtual CPU mesh here) installs the SlotCoalescer and
-    routes SigAgg / ParSigEx / ValidatorAPI through it; crypto_plane=off
-    opts out (VERDICT r3 next-step 3 production wiring)."""
+    8-device virtual CPU mesh here) installs the SlotCoalescer behind
+    the multi-tenant service boundary and routes SigAgg / ParSigEx /
+    ValidatorAPI through the tenant handle; crypto_plane=off opts out
+    (VERDICT r3 next-step 3 production wiring; ISSUE 8 tenancy)."""
     from charon_tpu.cmd.cli import main as cli
 
     out = tmp_path / "c"
@@ -141,12 +142,21 @@ def test_app_wires_crypto_plane_on_multidevice(tmp_path):
                 use_tpu_tbls=True,  # conftest provisions 8 CPU devices
             )
         )
-        plane = node.sigagg.plane
-        assert isinstance(plane, SlotCoalescer)
-        assert node.vapi.plane is plane
+        from charon_tpu.core.cryptosvc import TenantPlane
+
+        handle = node.sigagg.plane
+        assert isinstance(handle, TenantPlane)
+        assert node.vapi.plane is handle
         assert node.sigagg.pubshares_by_idx is not None
-        assert plane.plane.shard_count() == 8
-        assert plane.stats_hook is not None
+        coal = node.crypto_plane
+        assert isinstance(coal, SlotCoalescer)
+        assert node.crypto_svc is not None
+        assert node.crypto_svc.coalescer is coal
+        assert handle.t == coal.t
+        # the node's cluster is a registered tenant of the service
+        assert node.crypto_svc.tenant(handle.tenant_id) is not None
+        assert coal.plane.shard_count() == 8
+        assert coal.stats_hook is not None
 
         node_off = await build_node(
             Config(

@@ -1124,3 +1124,303 @@ def test_h2c_kernel_family_stays_on_bucket_ladder(monkeypatch):
         assert blsops.jit_cache_size() == len(ladder)
     finally:
         blsops.clear_kernel_caches()  # drop the fake for later tests
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant crypto-plane service (ISSUE 8): backpressure, fairness,
+# breaker, and the degradation ladder consuming shed load
+# ---------------------------------------------------------------------------
+
+from charon_tpu.core.cryptosvc import (  # noqa: E402
+    CryptoPlaneService,
+    PlaneOverloadError,
+    TenantQuota,
+)
+
+
+class StubCoalescer:
+    """Service-level stand-in for the shared SlotCoalescer: records
+    dispatch order (the EDF observable), optionally holds the 'device'
+    for delay seconds, and verdicts each lane by its truthiness —
+    items submitted as 0/None fail verification, everything else
+    passes (the forged-flood signal without any crypto)."""
+
+    def __init__(self, t: int = T, delay: float = 0.0):
+        self.t = t
+        self.delay = delay
+        self.calls: list[tuple[str, str | None, int]] = []
+
+    async def verify(self, items, deadline=None, tenant=None):
+        self.calls.append(("verify", tenant, len(items)))
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return [bool(it) for it in items]
+
+    async def recombine(
+        self, pubshares, roots, partials, group_pks, indices,
+        deadline=None, tenant=None,
+    ):
+        self.calls.append(("recombine", tenant, len(roots)))
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return [b"\x01" * 96] * len(roots), [True] * len(roots)
+
+
+def test_overload_fails_fast_never_blocks_the_loop():
+    """Submissions beyond the tenant's queue bounds raise the typed
+    PlaneOverloadError IMMEDIATELY (no await between check and raise),
+    while in-flight work completes normally; shed counters attribute
+    the rejections."""
+    stub = StubCoalescer(delay=0.2)
+    svc = CryptoPlaneService(stub, round_interval=0.001)
+    plane = svc.register(
+        "a", TenantQuota(max_queue_jobs=2, max_queue_lanes=100)
+    )
+
+    async def main():
+        first = asyncio.create_task(plane.verify([1]))
+        second = asyncio.create_task(plane.verify([1, 1]))
+        await asyncio.sleep(0.05)  # both dispatched, device busy
+        t0 = time.monotonic()
+        with pytest.raises(PlaneOverloadError) as exc:
+            await plane.verify([1])
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.1, "overload must fail fast, not queue"
+        assert exc.value.tenant == "a" and exc.value.reason == "jobs"
+        # lane bound sheds too (jobs bound not yet hit after drain)
+        assert await first == [True]
+        assert await second == [True, True]
+        with pytest.raises(PlaneOverloadError) as exc2:
+            await plane.verify([1] * 101)
+        assert exc2.value.reason == "lanes"
+
+    asyncio.run(main())
+    ten = svc.tenant("a")
+    assert ten.shed == {"jobs": 1, "lanes": 1}
+    assert ten.shed_lanes == 1 + 101
+    svc.close()
+
+
+def test_edf_preempts_flooder_backlog():
+    """A starved tenant's near-deadline duty dispatches ahead of a
+    flooder's queued no-deadline backlog: earliest-deadline-first
+    across tenants, within per-tenant round budgets."""
+    stub = StubCoalescer()
+    svc = CryptoPlaneService(stub, round_lanes=8, round_interval=0.03)
+    flood = svc.register("flood", TenantQuota(max_queue_lanes=10_000))
+    victim = svc.register("victim", TenantQuota())
+
+    async def main():
+        # budget/round = 8 * 1/2 = 4 lanes: one 4-lane entry per round
+        flood_tasks = [
+            asyncio.create_task(flood.verify([1] * 4)) for _ in range(3)
+        ]
+        await asyncio.sleep(0.005)  # round 1 dispatched one flood entry
+        res = await victim.verify([1] * 4, deadline=time.time() + 0.05)
+        assert res == [True] * 4
+        await asyncio.gather(*flood_tasks)
+
+    asyncio.run(main())
+    order = [tenant for _, tenant, _ in stub.calls]
+    assert order[0] == "flood"
+    # the victim preempted the flooder's remaining backlog
+    assert order.index("victim") < len(order) - 1
+    assert order.count("flood") == 3 and order.count("victim") == 1
+    svc.close()
+
+
+def test_breaker_open_quarantine_half_open_close():
+    """Forged-flood breaker lifecycle: persistent failed lanes open the
+    breaker (subsequent dispatches quarantine to the tenant's own
+    coalescer), the cooldown half-opens it, one clean quarantined
+    flush closes it — and a failing probe re-opens instead."""
+    shared = StubCoalescer()
+    quarantine = StubCoalescer()
+    transitions: list[tuple[str, str]] = []
+
+    def observer(kind, tenant, **f):
+        if kind == "breaker":
+            transitions.append((tenant, f["state"]))
+
+    svc = CryptoPlaneService(
+        shared,
+        round_interval=0.001,
+        observer=observer,
+        quarantine_factory=lambda tid: quarantine,
+    )
+    plane = svc.register(
+        "evil",
+        TenantQuota(
+            breaker_window=64,
+            breaker_min_lanes=8,
+            breaker_threshold=0.5,
+            breaker_cooldown=0.05,
+        ),
+    )
+
+    async def main():
+        # two clean flushes first: the window must TRIP on ratio, not
+        # on the first failure
+        assert await plane.verify([1, 1]) == [True, True]
+        assert svc.tenant("evil").breaker.state == "closed"
+        # forged flood: 8 failing lanes >= min_lanes at ratio >= 0.5
+        await plane.verify([0] * 8)
+        assert svc.tenant("evil").breaker.state == "open"
+        before = len(shared.calls)
+        # open: dispatches quarantine to the tenant's own coalescer
+        await plane.verify([0] * 4)
+        assert len(shared.calls) == before
+        assert quarantine.calls[-1] == ("verify", "evil", 4)
+        assert svc.tenant("evil").quarantined_flushes == 1
+        # cooldown elapses -> half-open; a failing probe re-opens
+        await asyncio.sleep(0.06)
+        await plane.verify([0, 1])
+        assert svc.tenant("evil").breaker.state == "open"
+        # cooldown again -> half-open; a CLEAN probe closes
+        await asyncio.sleep(0.06)
+        await plane.verify([1, 1])
+        assert svc.tenant("evil").breaker.state == "closed"
+        # closed again: back to the shared coalescer
+        await plane.verify([1])
+        assert shared.calls[-1] == ("verify", "evil", 1)
+
+    asyncio.run(main())
+    states = [s for _, s in transitions]
+    assert states == ["open", "half_open", "open", "half_open", "closed"]
+    svc.close()
+
+
+def test_shed_load_consumed_by_degradation_ladder():
+    """The submitters' existing ladders CATCH PlaneOverloadError and
+    serve shed work from the host tbls rung: Eth2Verifier inbound sets
+    still verify, SigAgg still aggregates — shed costs latency, never
+    a duty."""
+    from charon_tpu import tbls
+    from charon_tpu.core.parsigex import Eth2Verifier
+    from charon_tpu.core.sigagg import SigAgg
+    from tests.test_cryptoplane import FORK, _duty_workload
+    from charon_tpu.core.types import Duty, DutyType
+
+    impl = PythonImpl()
+    tbls.set_implementation(impl)
+    stub = StubCoalescer()
+    svc = CryptoPlaneService(stub, round_interval=0.001)
+    # zero-depth quota: EVERY submission sheds at admission
+    plane = svc.register("a", TenantQuota(max_queue_jobs=0))
+
+    pk, gpk, psigs, root, want, pubshares = _duty_workload(impl, slot=3)
+    pubshares_by_idx = {i: {pk: pubshares[i]} for i in pubshares}
+    duty = Duty(3, DutyType.ATTESTER)
+
+    async def main():
+        verifier = Eth2Verifier(FORK, pubshares_by_idx, plane=plane)
+        signed_set = {pk: psigs[0]}
+        assert await verifier.verify_async(duty, signed_set) is True
+
+        agg = SigAgg(
+            threshold=T,
+            fork=FORK,
+            plane=plane,
+            pubshares_by_idx=pubshares_by_idx,
+        )
+        out: dict = {}
+
+        async def sub(_duty, result):
+            out.update(result)
+
+        agg.subscribe(sub)
+        await agg.aggregate(duty, {pk: psigs})
+        assert out[pk].signature == want
+
+    asyncio.run(main())
+    # the plane never saw the work; the shed counters name the tenant
+    assert stub.calls == []
+    assert svc.tenant("a").shed.get("jobs", 0) == 2
+    svc.close()
+
+
+def test_cancelled_submission_dropped_not_dispatched():
+    """A tenant crash-loop cancels submissions mid-queue: the dead
+    entries are dropped at dispatch (never shipped, never wedge the
+    queue) and their pending accounting is released."""
+    stub = StubCoalescer(delay=0.05)
+    svc = CryptoPlaneService(stub, round_interval=0.01)
+    plane = svc.register("crashy", TenantQuota())
+
+    async def main():
+        hold = asyncio.create_task(plane.verify([1]))  # occupies device
+        await asyncio.sleep(0.005)
+        doomed = [
+            asyncio.create_task(plane.verify([1] * 2)) for _ in range(4)
+        ]
+        await asyncio.sleep(0)  # enqueue, then crash before dispatch
+        for task in doomed:
+            task.cancel()
+        await asyncio.gather(*doomed, return_exceptions=True)
+        assert await hold == [True]
+        # survivor submitted after the crash still round-trips
+        assert await plane.verify([1, 1]) == [True, True]
+
+    asyncio.run(main())
+    ten = svc.tenant("crashy")
+    assert ten.pending_jobs == 0 and ten.pending_lanes == 0
+    # none of the cancelled entries reached the coalescer
+    assert sum(n for _, _, n in stub.calls) == 3
+    svc.close()
+
+
+def test_flush_stats_carry_tenant_lanes():
+    """Tenant tags travel submission -> coalescer job -> FlushStats:
+    the per-flush attribution the tenant metrics and span-bridge tenant
+    attrs are built from."""
+    stats: list = []
+    coal = SlotCoalescer(
+        FakePlane(T), window=0.01, stats_hook=stats.append
+    )
+    svc = CryptoPlaneService(coal, round_interval=0.001)
+    a = svc.register("tenant-a", TenantQuota())
+    b = svc.register("tenant-b", TenantQuota())
+
+    async def main():
+        items = _sig_items(2)
+        await asyncio.gather(a.verify(items), b.verify(items[:1]))
+
+    asyncio.run(main())
+    svc.close()
+    coal.close()
+    per: dict[str, int] = {}
+    for s in stats:
+        for tenant, lanes in s.tenant_lanes:
+            per[tenant] = per.get(tenant, 0) + lanes
+    assert per == {"tenant-a": 2, "tenant-b": 1}
+
+
+def test_clock_step_does_not_collapse_armed_window():
+    """Regression (ISSUE 8 satellite): the wall->monotonic offset is
+    snapshotted ONCE per window, so a host clock step between two
+    submissions of the same window no longer shrinks or stretches the
+    armed flush — same wall deadline, same monotonic flush state."""
+    from charon_tpu.testutil.chaos import SkewedClock
+
+    coal = SlotCoalescer(FakePlane(T), window=0.5, window_min=0.001)
+
+    async def main():
+        with SkewedClock() as clock:
+            deadline = time.time() + 30.0
+            coal._arm(deadline)
+            armed_at = coal._flush_at
+            queue_deadline = coal._queue_deadline
+            clock.step(3600.0)  # host clock jumps forward an hour
+            coal._arm(deadline)
+            # pre-fix: deadline - time.time() went negative, the cap
+            # collapsed to window_min and the armed flush fired NOW
+            assert coal._queue_deadline == queue_deadline
+            assert coal._flush_at == armed_at
+            clock.step(-7200.0)  # and an hour backward past real time
+            coal._arm(deadline)
+            assert coal._queue_deadline == queue_deadline
+            assert coal._flush_at == armed_at
+        coal._flush_task.cancel()
+
+    asyncio.run(main())
+    coal.close()

@@ -500,3 +500,104 @@ def test_chaos_garbage_never_kills_transport_codec():
             codec.decode_binary(blob)
         except codec.CodecError:
             pass
+
+
+def test_peer_codec_quarantine_exponential_backoff(monkeypatch):
+    """Repeated CodecError frames from ONE peer inside the strike
+    window impose a temporary mute (frames drop before decode), a
+    repeat offense doubles the mute, and a clean frame after expiry
+    forgives the backoff level (ISSUE 8 satellite)."""
+    pytest.importorskip("cryptography")
+    import asyncio
+
+    from charon_tpu.p2p import transport as tmod
+
+    monkeypatch.setattr(tmod, "QUARANTINE_STRIKES", 3)
+    monkeypatch.setattr(tmod, "QUARANTINE_BASE", 0.2)
+    monkeypatch.setattr(tmod, "RECV_TIMEOUT", 0.5)
+
+    async def blast_malformed(src, dst_idx, n):
+        conn = src._conns[dst_idx]
+        async with conn.lock:
+            for _ in range(n):
+                tmod._write_sframe(conn, bytes([1, 0x7F, 0xFF, 0xFF]))
+            await conn.writer.drain()
+
+    async def run():
+        nodes = _make_mesh_mixed()
+        for node in nodes:
+            await node.start()
+        mutes = []
+        nodes[1].quarantine_observer = lambda p, m: mutes.append((p, m))
+        try:
+            assert await nodes[0].send(1, "ping", None, await_response=True)
+            # strikes 1..3 inside the window: mute imposed at base
+            await blast_malformed(nodes[0], 1, 3)
+            await asyncio.sleep(0.1)
+            assert nodes[1].peer_quarantines == 1
+            assert nodes[1].peer_quarantined(0)
+            assert mutes == [(0, 0.2)]
+            # while muted, even a VALID frame drops before decode
+            dropped_before = nodes[1].quarantined_frames
+            with pytest.raises(asyncio.TimeoutError):
+                await nodes[0].send(1, "ping", None, await_response=True)
+            assert nodes[1].quarantined_frames > dropped_before
+            # repeat offense right after expiry: the mute DOUBLES
+            await asyncio.sleep(0.2)
+            await blast_malformed(nodes[0], 1, 3)
+            await asyncio.sleep(0.1)
+            assert mutes == [(0, 0.2), (0, 0.4)]
+            # a clean frame after expiry forgives the backoff level
+            await asyncio.sleep(0.45)
+            assert await nodes[0].send(1, "ping", None, await_response=True)
+            assert not nodes[1]._quarantine._level
+            # next offense starts back at the base mute
+            await blast_malformed(nodes[0], 1, 3)
+            await asyncio.sleep(0.1)
+            assert mutes[-1] == (0, 0.2)
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_quarantine_state_machine_fake_clock():
+    """The quarantine state machine itself (p2p/quarantine.py), driven
+    on a fake clock: strike-window expiry, exponential backoff across
+    repeat offenses capped at max_mute, and forgiveness — the
+    cryptography-free half every environment exercises."""
+    from charon_tpu.p2p.quarantine import PeerQuarantine
+
+    now = [0.0]
+    mutes = []
+    q = PeerQuarantine(
+        strikes=3, window=10.0, base=2.0, max_mute=6.0,
+        observer=lambda p, m: mutes.append((p, m)), clock=lambda: now[0],
+    )
+    # two strikes then the window expires: no mute
+    assert q.strike(7) is None and q.strike(7) is None
+    now[0] += 11.0
+    assert q.strike(7) is None and not q.muted(7)
+    # three inside the window: base mute
+    assert q.strike(7) is None and q.strike(7) == 2.0
+    assert q.muted(7) and q.quarantines == 1
+    # other peers are unaffected
+    assert not q.muted(8)
+    # repeat offenses double, capped at max_mute
+    now[0] += 2.5
+    assert not q.muted(7)
+    for _ in range(2):
+        q.strike(7)
+    assert q.strike(7) == 4.0
+    now[0] += 4.5
+    for _ in range(2):
+        q.strike(7)
+    assert q.strike(7) == 6.0  # 8.0 capped at max_mute
+    # forgiveness resets the backoff level
+    now[0] += 6.5
+    q.forgive(7)
+    for _ in range(2):
+        q.strike(7)
+    assert q.strike(7) == 2.0
+    assert mutes == [(7, 2.0), (7, 4.0), (7, 6.0), (7, 2.0)]
