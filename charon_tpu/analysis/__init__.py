@@ -1,0 +1,23 @@
+"""Machine-checked project invariants (ISSUE 10).
+
+Every perf/robustness PR so far established an invariant by convention —
+monotonic clocks in scheduling code (the PR 8 `_arm` wall-clock bug),
+typed errors at trust boundaries, jax-free host modules, append-only
+codec wire ids, race-free shared state — and each was enforced only by
+whoever remembered it. This package makes them enforcement, not lore:
+
+  lint.py            AST lint framework + CLI
+                     (`python -m charon_tpu.analysis.lint charon_tpu/`)
+  rule_*.py          one module per project rule, each grounded in a
+                     real past bug (module docstrings cite them)
+  sanitizer.py       runtime concurrency sanitizer: lock-order cycle
+                     detection + thread/asyncio-task leak detectors
+                     (pytest fixture in tests/conftest.py)
+  schema_check.py    append-only wire-schema contract for p2p/codec
+                     against tests/testdata/wire_schema.json
+  metrics_check.py   app/metrics.py <-> docs/metrics.md catalogue sync
+
+Everything here is deliberately jax-free (and lints itself for it): the
+`ci.sh analysis` tier must run on any host, including the jax-less CI
+images that already run bench_wire.py.
+"""

@@ -1,0 +1,248 @@
+"""jax-free-host: documented jax-less modules must not import jax.
+
+Several host-side modules are load-bearing on jax-less hosts: the wire
+codec and quarantine state machine (bench_wire.py runs them on the
+jax-less CI image), app/metrics.py (scraped in every process, including
+the promrated sidecar), testutil/chaos.py (the seeded fault plane must
+inject into pure-host tests), the hostplane/wire benches themselves,
+and this analysis package (the `ci.sh analysis` tier runs everywhere).
+One careless module-scope `import jax` — or an innocent-looking
+`from charon_tpu.core import X` whose import chain reaches jax —
+breaks every one of those hosts at import time, and nothing catches it
+until the jax-less CI leg runs.
+
+The rule: for each module in the jax-free set (explicit list below,
+plus any module whose docstring claims to be "jax-free"/"jax-less"),
+no *unguarded module-scope* import may reach `jax`/`jaxlib`, directly
+or transitively through charon_tpu-internal imports. Guarded imports
+(inside `try/except ImportError` — the established "tolerates a
+jax-less host" pattern, e.g. core/cryptoplane's optional ops import)
+are soft edges and allowed; `if TYPE_CHECKING:` blocks are ignored.
+Module-scope *calls* to module-level functions (the
+`_register_core_types()` pattern in p2p/codec.py) count as module
+scope: their imports execute at import time all the same.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator
+
+from charon_tpu.analysis.lint import (
+    LintModule,
+    Rule,
+    Violation,
+    scope_key,
+)
+
+_JAX_FREE_PREFIXES = ("charon_tpu/analysis/",)
+_JAX_FREE_FILES = frozenset(
+    {
+        "charon_tpu/p2p/codec.py",
+        "charon_tpu/p2p/quarantine.py",
+        "charon_tpu/app/metrics.py",
+        "charon_tpu/testutil/chaos.py",
+        "bench_wire.py",
+        "bench_hostplane.py",
+    }
+)
+_DOC_MARK = re.compile(r"jax[- ](free|less)", re.IGNORECASE)
+
+
+def _docstring_claims_jax_free(tree: ast.AST) -> bool:
+    doc = ast.get_docstring(tree, clean=False) or ""
+    return bool(_DOC_MARK.search(doc))
+
+
+def _module_scope_imports(
+    tree: ast.Module,
+) -> list[tuple[str, int, bool]]:
+    """(dotted_module, lineno, guarded) for every import that executes
+    at module import time. `guarded` = inside a try whose handlers
+    catch ImportError/ModuleNotFoundError/Exception (the module works
+    without it). `if TYPE_CHECKING:` bodies never execute — skipped."""
+    out: list[tuple[str, int, bool]] = []
+    funcs = {
+        n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+    }
+
+    def _is_type_checking(test: ast.AST) -> bool:
+        return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+            isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+        )
+
+    def _catches_import_error(handlers) -> bool:
+        names = set()
+        for h in handlers:
+            t = h.type
+            if t is None:
+                return True
+            for n in t.elts if isinstance(t, ast.Tuple) else [t]:
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    names.add(n.attr)
+        return bool(
+            names & {"ImportError", "ModuleNotFoundError", "Exception",
+                     "BaseException"}
+        )
+
+    def walk(stmts, guarded: bool) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Import):
+                for a in st.names:
+                    out.append((a.name, st.lineno, guarded))
+            elif isinstance(st, ast.ImportFrom):
+                if st.level:  # relative import; repo uses absolute
+                    continue
+                if st.module:
+                    for a in st.names:
+                        # `from pkg import sub` may bind a submodule —
+                        # record both candidates, resolver picks
+                        out.append(
+                            (f"{st.module}.{a.name}", st.lineno, guarded)
+                        )
+                        out.append((st.module, st.lineno, guarded))
+            elif isinstance(st, ast.Try):
+                g = guarded or _catches_import_error(st.handlers)
+                walk(st.body, g)
+                walk(st.orelse, guarded)
+                walk(st.finalbody, guarded)
+                for h in st.handlers:
+                    walk(h.body, guarded)
+            elif isinstance(st, ast.If):
+                if _is_type_checking(st.test):
+                    walk(st.orelse, guarded)
+                    continue
+                walk(st.body, guarded)
+                walk(st.orelse, guarded)
+            elif isinstance(st, (ast.With, ast.For, ast.While)):
+                walk(st.body, guarded)
+                walk(getattr(st, "orelse", []), guarded)
+            elif (
+                isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Name)
+                and st.value.func.id in funcs
+            ):
+                # module-scope call of a module-level function: its
+                # imports execute at import time (codec's
+                # _register_core_types pattern)
+                fn = funcs[st.value.func.id]
+                walk(fn.body, guarded)
+
+    walk(tree.body, False)
+    return out
+
+
+class JaxFreeHost(Rule):
+    name = "jax-free-host"
+    description = (
+        "documented jax-free modules must not reach jax via unguarded "
+        "module-scope imports (directly or transitively)"
+    )
+
+    def __init__(self) -> None:
+        self._cache: dict[Path, list[tuple[str, int, bool]] | None] = {}
+
+    def applies(self, mod: LintModule) -> bool:
+        key = scope_key(mod.relpath)
+        return (
+            key in _JAX_FREE_FILES
+            or key.startswith(_JAX_FREE_PREFIXES)
+            or _docstring_claims_jax_free(mod.tree)
+        )
+
+    # -- transitive resolution --------------------------------------------
+
+    def _root(self, mod: LintModule) -> Path | None:
+        if mod.path is None:
+            return None
+        key = scope_key(mod.relpath)
+        p = mod.path.resolve()
+        if key.startswith("charon_tpu/"):
+            # strip the key's components off the real path
+            for _ in key.split("/"):
+                p = p.parent
+            return p
+        return p.parent
+
+    def _imports_of(self, root: Path, dotted: str):
+        """Module-scope imports of `dotted` (charon_tpu.*), or None when
+        it doesn't resolve to a file (attr import / namespace miss)."""
+        rel = dotted.split(".")
+        for cand in (
+            root.joinpath(*rel).with_suffix(".py"),
+            root.joinpath(*rel, "__init__.py"),
+        ):
+            if cand.is_file():
+                if cand not in self._cache:
+                    try:
+                        tree = ast.parse(
+                            cand.read_text(encoding="utf-8")
+                        )
+                        self._cache[cand] = _module_scope_imports(tree)
+                    except (OSError, SyntaxError):
+                        self._cache[cand] = None
+                return self._cache[cand], cand
+        return None, None
+
+    def _package_chain(self, dotted: str) -> list[str]:
+        """Importing a.b.c executes a/__init__, a.b/__init__ too."""
+        parts = dotted.split(".")
+        return [".".join(parts[: i + 1]) for i in range(len(parts) - 1)]
+
+    def check(self, mod: LintModule) -> Iterator[Violation]:
+        root = self._root(mod)
+        seen: set[str] = set()
+
+        def reaches_jax(dotted: str, depth: int) -> list[str] | None:
+            """Chain of modules from `dotted` to a jax import, or None."""
+            if dotted.split(".")[0] in ("jax", "jaxlib"):
+                return [dotted]
+            if not dotted.startswith("charon_tpu") or root is None:
+                return None
+            if dotted in seen or depth > 12:
+                return None
+            seen.add(dotted)
+            imports, _ = self._imports_of(root, dotted)
+            if imports is None:
+                return None
+            for sub, _line, guarded in imports:
+                if guarded:
+                    continue
+                chain = reaches_jax(sub, depth + 1)
+                if chain is not None:
+                    return [dotted] + chain
+            return None
+
+        flagged_lines: set[int] = set()
+        for dotted, line, guarded in _module_scope_imports(mod.tree):
+            if guarded or line in flagged_lines:
+                continue
+            if dotted.split(".")[0] in ("jax", "jaxlib"):
+                flagged_lines.add(line)
+                yield Violation(
+                    self.name,
+                    mod.relpath,
+                    line,
+                    "jax imported at module scope in a jax-free module "
+                    "(guard it behind try/except ImportError or move it "
+                    "into the function that needs it)",
+                )
+                continue
+            if dotted.startswith("charon_tpu"):
+                for hop in self._package_chain(dotted) + [dotted]:
+                    chain = reaches_jax(hop, 0)
+                    if chain is not None:
+                        flagged_lines.add(line)
+                        yield Violation(
+                            self.name,
+                            mod.relpath,
+                            line,
+                            "module-scope import chain reaches jax: "
+                            + " -> ".join(chain),
+                        )
+                        break

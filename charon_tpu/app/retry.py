@@ -38,13 +38,39 @@ RETRYABLE = (ConnectionError, TimeoutError, asyncio.TimeoutError, OSError)
 
 
 class Retryer:
-    """deadline_of: maps a duty to its absolute deadline (SlotClock)."""
+    """deadline_of: maps a duty to its absolute wall-clock deadline
+    (SlotClock). `now` (default: live `time.time`) is the wall clock
+    the deadlines live on; `mono` is the clock the retry loop actually
+    runs against. With the defaults the wall deadline is anchored to
+    `time.monotonic()` ONCE per retry() call, so a host clock step
+    mid-retry (NTP correction, chaos SkewedClock) can neither abort
+    the remaining window nor stretch it past the duty deadline — the
+    `_arm` bug class. Tests that inject a fake `now` drive a single
+    steppable timeline and get `mono = now` automatically (one clock
+    has no skew to misconvert)."""
 
-    def __init__(self, deadline_of, now=time.time, backoff: float = BACKOFF_SECS) -> None:
+    def __init__(
+        self,
+        deadline_of,
+        now=None,
+        backoff: float = BACKOFF_SECS,
+        mono=None,
+    ) -> None:
         self.deadline_of = deadline_of
         self.now = now
         self.backoff = backoff
+        self.mono = mono
         self._tasks: set[asyncio.Task] = set()
+
+    def _clocks(self):
+        """(wall, mono) pair the loop runs on. Live `time.time` is read
+        through the module attribute so clock-skew injection sees it."""
+        if self.now is None:
+            return (lambda: time.time()), (  # lint: allow(monotonic-clock) — wall INPUT timeline; loop runs on mono
+                self.mono if self.mono is not None else time.monotonic
+            )
+        # injected wall clock IS the test's single timeline
+        return self.now, (self.mono if self.mono is not None else self.now)
 
     async def retry(self, name: str, duty, fn, *args) -> None:
         """Deadline-bounded, not attempt-bounded: each attempt runs
@@ -53,18 +79,20 @@ class Retryer:
         the loop then stops at the deadline check. Cancellation (duty
         torn down / process stopping) propagates immediately: it is a
         BaseException and never swallowed as a retry."""
-        deadline = self.deadline_of(duty)
+        now, mono = self._clocks()
+        # wall deadline -> monotonic base, snapshotted once (PR 8 _arm)
+        deadline = self.deadline_of(duty) - now() + mono()
         attempt = 0
         while True:
             attempt += 1
-            remaining = deadline - self.now()
+            remaining = deadline - mono()
             if remaining <= 0:
                 return  # deadline exceeded; tracker reports the miss
             try:
                 await asyncio.wait_for(fn(duty, *args), timeout=remaining)
                 return
             except retryable_errors():
-                if self.now() + self.backoff >= deadline:
+                if mono() + self.backoff >= deadline:
                     return  # deadline exceeded; tracker reports the miss
                 await asyncio.sleep(self.backoff)
             except Exception:

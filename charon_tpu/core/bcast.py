@@ -47,14 +47,24 @@ class Broadcaster:
         from charon_tpu.app.retry import retryable_errors
 
         attempt = 0
+        # wall duty deadline anchored to monotonic ONCE, at entry while
+        # the clock is still honest (the PR 8 _arm bug class): a host
+        # clock step mid-retry must neither abort the remaining window
+        # nor retry past the duty deadline
+        deadline_mono = (
+            None
+            if self.clock is None
+            else time.monotonic()
+            + (self.clock.duty_deadline(duty) - time.time())  # lint: allow(monotonic-clock) — one-shot wall->mono anchor
+        )
         while True:
             try:
                 return await fn(*args)
             except retryable_errors() as e:
-                if self.clock is None:
+                if deadline_mono is None:
                     raise
                 delay = backoff_delay(FAST_CONFIG, attempt)
-                if time.time() + delay >= self.clock.duty_deadline(duty):
+                if time.monotonic() + delay >= deadline_mono:
                     raise
                 if attempt == 0:
                     from charon_tpu.app import log
@@ -125,7 +135,9 @@ class Broadcaster:
         )
         if self.clock is not None:
             self.broadcast_delay.append(
-                (duty, time.time() - self.clock.slot_start(duty.slot))
+                # attribution edge: delay INTO the slot — both terms live
+                # on the wall timeline (slots are wall-clock)
+                (duty, time.time() - self.clock.slot_start(duty.slot))  # lint: allow(monotonic-clock)
             )
         for sub in self._subs:
             # post-broadcast observers (inclusion checker) are

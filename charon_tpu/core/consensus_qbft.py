@@ -273,7 +273,9 @@ class QBFTConsensus:
 
         self._sniffer.append(
             {
-                "ts": round(_time.time(), 3),
+                # debug-sniffer timestamp: a logging edge operators
+                # correlate with wall-clock log lines, never math
+                "ts": round(_time.time(), 3),  # lint: allow(monotonic-clock)
                 "dir": direction,
                 "duty": str(duty),
                 "type": getattr(msg.type, "name", str(msg.type)),

@@ -160,6 +160,12 @@ class FlushStats:
     tenant_lanes: tuple[tuple[str, int], ...] = ()
 
 
+class PlaneConfigError(ValueError):
+    """Invalid crypto-plane configuration (typed-errors invariant: a
+    config mistake at the plane boundary must be distinguishable from
+    wire/crypto failures — it is a deploy bug, never degradable load)."""
+
+
 def _decode_pubkey(pk: bytes):
     from charon_tpu.tbls.tpu_impl import _cached_pubkey_point
 
@@ -281,7 +287,7 @@ class SlotCoalescer:
         # degradation rung below device (PR 2 ladder): a device failure
         # in a parsed flush steps this coalescer down permanently.
         if decode_mode not in ("auto", "device", "python"):
-            raise ValueError(f"bad decode_mode {decode_mode!r}")
+            raise PlaneConfigError(f"bad decode_mode {decode_mode!r}")
         self.decode_mode = decode_mode
         self._decode_live: str | None = None  # resolved lazily
         # msm-off degradation rung (mirrors tbls/tpu_impl._rlc_guarded):
@@ -415,18 +421,22 @@ class SlotCoalescer:
         # closed: inline decode instead of resurrecting a pool nobody
         # will shut down (the flush fails these waiters fast anyway)
         if self.decode_workers <= 0 or self._closed:
-            w0 = time.time()
+            # stage spans are ATTRIBUTION: wall-clock windows bridged
+            # into duty traces (tracer.plane_span_bridge), never math
+            w0 = time.time()  # lint: allow(monotonic-clock)
             out = [fn(it) for it in items]
-            return out, (), ((w0, time.time()),)
+            return out, (), ((w0, time.time()),)  # lint: allow(monotonic-clock)
         loop = asyncio.get_running_loop()
         pool = self._pool()
         submitted = time.monotonic()
 
         def run_chunk(chunk):
             t0 = time.monotonic()
-            w0 = time.time()
+            # wall span = trace attribution; the queue DELAY above it
+            # stays on the monotonic base
+            w0 = time.time()  # lint: allow(monotonic-clock)
             out = [fn(it) for it in chunk]
-            return out, t0 - submitted, (w0, time.time())
+            return out, t0 - submitted, (w0, time.time())  # lint: allow(monotonic-clock)
 
         chunks = [
             items[i : i + self.DECODE_CHUNK]
@@ -601,7 +611,7 @@ class SlotCoalescer:
             # call meant a host clock step mid-window (chaos clock-skew)
             # translated later submissions' deadlines inconsistently,
             # wrongly collapsing or stretching the armed window.
-            self._wall_offset = now - time.time()
+            self._wall_offset = now - time.time()  # lint: allow(monotonic-clock) — THE one-shot wall->mono anchor (PR 8 fix)
         if deadline is not None:
             dl_mono = max(now, deadline + self._wall_offset)
             if self._queue_deadline is None or dl_mono < self._queue_deadline:
@@ -851,7 +861,8 @@ class SlotCoalescer:
         whole flush. Returns (vpack, rpack, pack_span) for _run_device's
         packed fast path — this is the half of the old verify_host/
         recombine_host work that does NOT need the device lane."""
-        w0 = time.time()
+        # pack span = wall-clock trace attribution (FlushStats bridge)
+        w0 = time.time()  # lint: allow(monotonic-clock)
         plane = self.plane
         parsed = self._normalize_jobs(vq, rq)
         vpack = None
@@ -879,7 +890,7 @@ class SlotCoalescer:
                 len(msg),
                 parsed,
             )
-        return vpack, rpack, (w0, time.time())
+        return vpack, rpack, (w0, time.time())  # lint: allow(monotonic-clock)
 
     # -- device side (worker thread) --------------------------------------
 
@@ -894,7 +905,8 @@ class SlotCoalescer:
         # counters update only AFTER both stages succeed: a failed flush
         # that the degrade rung retries must not double-count its lanes
         t0 = time.monotonic()
-        w0 = time.time()
+        # device span = wall-clock trace attribution; durations use t0
+        w0 = time.time()  # lint: allow(monotonic-clock)
         vpack, rpack, pack_span = (
             packed if packed is not None else (None, None, None)
         )
@@ -1008,7 +1020,7 @@ class SlotCoalescer:
                 decode_python_lanes=python_n,
                 decode_spans=self._job_decode_spans(vq, rq),
                 pack_span=pack_span,
-                device_span=(w0, time.time()),
+                device_span=(w0, time.time()),  # lint: allow(monotonic-clock)
                 parents=self._job_parents(vq, rq),
                 tenant_lanes=self._job_tenant_lanes(vq, rq),
             ),
@@ -1386,7 +1398,7 @@ class SlotCoalescer:
         from charon_tpu.crypto import shamir
 
         t0 = time.monotonic()
-        w0 = time.time()
+        w0 = time.time()  # lint: allow(monotonic-clock) — device span is trace attribution
         # a parsed flush can land here when every device rung failed:
         # force the python lane representation first (worker thread —
         # the bigint decompression belongs here, not the event loop)
@@ -1442,7 +1454,7 @@ class SlotCoalescer:
                 decode_device_lanes=device_n,
                 decode_python_lanes=python_n,
                 decode_spans=self._job_decode_spans(vq, rq),
-                device_span=(w0, time.time()),
+                device_span=(w0, time.time()),  # lint: allow(monotonic-clock)
                 parents=self._job_parents(vq, rq),
                 tenant_lanes=self._job_tenant_lanes(vq, rq),
             ),

@@ -52,6 +52,12 @@ from dataclasses import dataclass, field
 from charon_tpu.tbls import TblsError
 
 
+class TenantConfigError(ValueError):
+    """Invalid service wiring (duplicate tenant registration etc.) —
+    a deploy/programming bug, typed so the plane's load-shedding
+    handlers (which catch TblsError) never mistake it for overload."""
+
+
 class PlaneOverloadError(TblsError):
     """Typed fail-fast admission rejection: the tenant's submission
     queue is over its configured depth. A TblsError subclass so generic
@@ -268,7 +274,9 @@ class CryptoPlaneService:
         self, tenant_id: str, quota: TenantQuota | None = None
     ) -> TenantPlane:
         if tenant_id in self._tenants:
-            raise ValueError(f"tenant {tenant_id!r} already registered")
+            raise TenantConfigError(
+                f"tenant {tenant_id!r} already registered"
+            )
         quota = quota or TenantQuota()
 
         def on_breaker(state: str, _tid=tenant_id) -> None:

@@ -52,7 +52,10 @@ class Deadliner:
         self,
         clock: SlotClock,
         on_expired: Callable[[Duty], Awaitable[None] | None],
-        now: Callable[[], float] = time.time,
+        # wall clock by design: duty expiry tracks the slot timeline,
+        # which IS wall-clock (genesis arithmetic) — an operator clock
+        # step SHOULD move expiries with the chain's real schedule
+        now: Callable[[], float] = time.time,  # lint: allow(monotonic-clock)
     ) -> None:
         self._clock = clock
         self._cb = on_expired

@@ -115,7 +115,9 @@ class InclusionChecker:
         if self.clock is not None:
             import time as _time
 
-            delay = _time.time() - self.clock.slot_start(duty.slot)
+            # attribution edge: inclusion delay vs the slot's wall-clock
+            # start — both terms live on the wall timeline
+            delay = _time.time() - self.clock.slot_start(duty.slot)  # lint: allow(monotonic-clock)
         for pubkey, signed in data_set.items():
             att_root = None
             bits: tuple[bool, ...] = ()

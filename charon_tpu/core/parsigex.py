@@ -56,7 +56,9 @@ class DutyGater:
         self,
         clock: SlotClock,
         slots_per_epoch: int = 32,
-        now: Callable[[], float] = time.time,
+        # wall clock by design: gating maps "now" onto the slot
+        # timeline, which IS wall-clock (SlotClock genesis arithmetic)
+        now: Callable[[], float] = time.time,  # lint: allow(monotonic-clock)
     ) -> None:
         self._clock = clock
         self._spe = slots_per_epoch
@@ -116,7 +118,13 @@ class Eth2Verifier:
         """Plane path: inbound sets from all peers land within one
         coalescing window and verify as ONE sharded device program."""
         if self.plane is None:
-            return self.verify(duty, signed_set)
+            # plane-less rung: deliberately INLINE — the executor hop
+            # GIL-convoys the busy loop and reorders inbound-set timing
+            # (measured multi-x e2e slowdown); production wires the
+            # plane. The overload-shed path below IS off-loop: it runs
+            # exactly when the plane is saturated and the loop must
+            # stay live.
+            return self.verify(duty, signed_set)  # lint: allow(event-loop-blocking)
         items = self._items(duty, signed_set)
         if items is None:
             return False
@@ -239,8 +247,15 @@ class ParSigEx:
                 duty=str(duty),
                 err=f"{type(e).__name__}: {e}",
             )
+            # anchor the wall duty deadline to the monotonic base HERE,
+            # at failure time while the clock is still honest (the PR 8
+            # _arm bug class) — the retry task then runs entirely on
+            # monotonic, immune to host clock steps mid-backoff
+            deadline_mono = time.monotonic() + (
+                self.clock.duty_deadline(duty) - time.time()  # lint: allow(monotonic-clock) — one-shot wall->mono anchor
+            )
             task = asyncio.create_task(
-                self._resend(duty, signed_set, tctx)
+                self._resend(duty, signed_set, tctx, deadline_mono)
             )
             self._retry_tasks.add(task)
             task.add_done_callback(self._retry_tasks.discard)
@@ -252,17 +267,20 @@ class ParSigEx:
         return encode_ctx()
 
     async def _resend(
-        self, duty: Duty, signed_set, tctx: str | None = None
+        self, duty: Duty, signed_set, tctx: str | None, deadline: float
     ) -> None:
+        """`deadline` is MONOTONIC-base (anchored by broadcast at
+        failure time), so the backoff loop below never reads the wall
+        clock — a host clock step mid-retry can neither abort the
+        remaining resends nor resend past expiry."""
         import asyncio
 
         from charon_tpu.app.expbackoff import FAST_CONFIG, backoff_delay
 
-        deadline = self.clock.duty_deadline(duty)
         attempt = 0
         while True:
             delay = backoff_delay(FAST_CONFIG, attempt)
-            if time.time() + delay >= deadline:
+            if time.monotonic() + delay >= deadline:
                 return  # deadline exhausted; tracker reports the miss
             await asyncio.sleep(delay)
             attempt += 1
@@ -305,11 +323,13 @@ class ParSigEx:
         ):
             if self.verifier is not None:
                 check = getattr(self.verifier, "verify_async", None)
-                ok = (
-                    await check(duty, signed_set)
-                    if check is not None
-                    else self.verifier.verify(duty, signed_set)
-                )
+                if check is not None:
+                    ok = await check(duty, signed_set)
+                else:
+                    # duck-typed sync verifier (test fakes): inline on
+                    # purpose, same rationale as verify_async's plane-
+                    # less rung above
+                    ok = self.verifier.verify(duty, signed_set)  # lint: allow(event-loop-blocking)
                 if not ok:
                     return  # drop invalid sets (logged/tracked in the full stack)
             for sub in self._subs:

@@ -82,7 +82,9 @@ class Scheduler:
         clock: SlotClock,
         validators: dict[PubKey, int],
         slots_per_epoch: int = 32,
-        now: Callable[[], float] = time.time,
+        # wall clock by design: the slot ticker follows the chain's
+        # wall-clock schedule (genesis arithmetic) — skew tests inject
+        now: Callable[[], float] = time.time,  # lint: allow(monotonic-clock)
         sleep: Callable[[float], Awaitable[None]] = asyncio.sleep,
     ) -> None:
         self.beacon = beacon

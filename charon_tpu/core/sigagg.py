@@ -79,7 +79,12 @@ class SigAgg:
                 duty, epoch, pubkeys, partial_maps, templates
             )
         else:
-            group_sigs = self._aggregate_via_tbls(
+            # plane-less rung: deliberately INLINE (see ValidatorAPI.
+            # _check_batch — the executor hop GIL-convoys the loop and
+            # distorts duty timing); production wires the plane, and
+            # the overload-shed branch in _aggregate_via_plane runs
+            # off-loop where it matters
+            group_sigs = self._aggregate_via_tbls(  # lint: allow(event-loop-blocking)
                 epoch, pubkeys, partial_maps, templates
             )
 

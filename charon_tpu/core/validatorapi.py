@@ -269,6 +269,11 @@ class ValidatorAPI:
                     None, tbls.verify_batch, items
                 )
         else:
-            ok = tbls.verify_batch(items)
+            # plane-less rung (simnet/unit wiring + the no-accelerator
+            # floor): deliberately INLINE — an executor hop here GIL-
+            # convoys the busy loop and reorders duty timing (measured
+            # 7-17x e2e slowdown); production wires the plane, whose
+            # path above is truly async
+            ok = tbls.verify_batch(items)  # lint: allow(event-loop-blocking)
         if not all(ok):
             raise VapiError("partial signature failed pubshare verification")

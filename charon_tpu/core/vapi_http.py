@@ -873,7 +873,9 @@ class VapiRouter:
             else:
                 import time as _t
 
-                slot = self.clock.slot_at(_t.time())
+                # wall by design: "current slot" is wall-clock genesis
+                # arithmetic, same timeline the VC's BN view uses
+                slot = self.clock.slot_at(_t.time())  # lint: allow(monotonic-clock)
         except ValueError:
             return _err(400, "bad slot")
         defs = (

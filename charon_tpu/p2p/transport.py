@@ -78,6 +78,13 @@ class HandshakeError(StructuredError):
     (ref: app/errors structured errors at the p2p boundary)."""
 
 
+class FrameError(ValueError):
+    """Unsendable frame at the transport boundary (oversize payload).
+    A ValueError subclass so broadcast()'s payload-bug logging keeps
+    seeing it, typed so transport handlers can tell a local framing
+    bug from the network errors the hysteresis counters absorb."""
+
+
 @dataclass
 class _Conn:
     reader: asyncio.StreamReader
@@ -598,7 +605,10 @@ class P2PNode:
                     async with conn.lock:
                         _write_sframe(conn, body)
                         await conn.writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        # task-body terminus: cancellation (node stop) ENDS this loop —
+        # there is no awaiting canceller to starve, and the conn cleanup
+        # it exists for runs in the finally below either way
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):  # lint: allow(no-swallowed-cancellation)
             pass
         finally:
             self._conns.pop(conn.peer_idx, None)
@@ -625,7 +635,7 @@ class P2PNode:
 
 def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     if len(payload) > MAX_FRAME:
-        raise ValueError("frame exceeds max size")
+        raise FrameError("frame exceeds max size")
     # two writes, no header+payload concatenation: the transport never
     # copies a large frame just to prefix 4 bytes
     writer.write(len(payload).to_bytes(4, "big"))

@@ -20,6 +20,7 @@ chaos suite (testutil/chaos.FlakyBackend forces the errors).
 
 from __future__ import annotations
 
+import threading
 from typing import Mapping, Sequence
 
 from charon_tpu.tbls import Implementation, TblsError
@@ -29,7 +30,15 @@ class ResilientImpl(Implementation):
     """impls: backends in preference order (fastest first). All calls go
     to the active rung; a non-TblsError failure retries the same call on
     the next rung, and `demote_after` consecutive active-rung failures
-    demote the active rung for good."""
+    demote the active rung for good.
+
+    Thread-safe: the ladder is hammered concurrently — the coalescer's
+    decode pool, the serialized device lane, AND the overload-shed
+    `run_in_executor` hops in parsigex/sigagg/validatorapi all call it.
+    The streak/demote bookkeeping runs under one lock so a burst of
+    concurrent failures demotes the broken rung exactly ONCE (two
+    racing threads used to each append a demotion and double-step the
+    ladder past a healthy rung)."""
 
     def __init__(
         self, impls: Sequence[Implementation], demote_after: int = 2
@@ -42,6 +51,7 @@ class ResilientImpl(Implementation):
         self.fallback_calls = 0  # calls served below the active rung
         self.demotions: list[int] = []  # rung indices demoted, in order
         self._fail_streak = 0
+        self._mu = threading.Lock()  # guards streak/active/counters
 
     def _call(self, name: str, *args, **kwargs):
         i = self.active
@@ -54,26 +64,32 @@ class ResilientImpl(Implementation):
             except Exception as e:  # noqa: BLE001 — backend fault
                 if i + 1 >= len(self.impls):
                     raise  # ladder exhausted: surface the fault
-                if i == self.active:
-                    self._fail_streak += 1
-                    if self._fail_streak >= self.demote_after:
-                        from charon_tpu.app import log
+                demoted = None
+                with self._mu:
+                    if i == self.active:
+                        self._fail_streak += 1
+                        if self._fail_streak >= self.demote_after:
+                            self.demotions.append(i)
+                            self.active = i + 1
+                            self._fail_streak = 0
+                            demoted = type(impl).__name__
+                    self.fallback_calls += 1
+                if demoted is not None:
+                    from charon_tpu.app import log
 
-                        log.warn(
-                            "tbls backend demoted",
-                            topic="tbls",
-                            rung=i,
-                            backend=type(impl).__name__,
-                            err=f"{type(e).__name__}: {str(e)[:120]}",
-                        )
-                        self.demotions.append(i)
-                        self.active = i + 1
-                        self._fail_streak = 0
+                    log.warn(
+                        "tbls backend demoted",
+                        topic="tbls",
+                        rung=i,
+                        backend=demoted,
+                        err=f"{type(e).__name__}: {str(e)[:120]}",
+                    )
                 i += 1
-                self.fallback_calls += 1
                 continue
             if i == self.active:
-                self._fail_streak = 0
+                with self._mu:
+                    if i == self.active:
+                        self._fail_streak = 0
             return result
 
     # -- the 11-op contract + batch extensions, all via the ladder --------

@@ -8,6 +8,7 @@
 #   ./ci.sh chaos     # seeded chaos scenarios only (subset of fast)
 #   ./ci.sh hostplane # event-loop-stall regression guard (subset of fast)
 #   ./ci.sh obs       # observability gate: monitoring endpoint + span export
+#   ./ci.sh analysis  # project-invariant linter + schema/metrics checkers
 #
 # Every tier pins JAX to CPU (the canonical test env; TPU runs go
 # through bench.py / the dryrun) and a fixed PYTHONHASHSEED so the
@@ -47,7 +48,28 @@ case "$TIER" in
     # burst's host CPU >= 5x vs the JSON wire path, and the vectorized
     # bytes->limb pass must beat the per-int loop >= 5x
     python bench_wire.py --smoke
+    # analysis gate (ISSUE 10): project-invariant linter + append-only
+    # wire-schema + metrics-catalogue sync (seconds; jax-free)
+    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py
+    python -m charon_tpu.analysis.schema_check
+    python -m charon_tpu.analysis.metrics_check
     exec python obs_check.py --fast
+    ;;
+  analysis)
+    # Wall-clock budget: seconds. Machine-checked project invariants
+    # (ISSUE 10): the AST linter (monotonic-clock, typed-errors,
+    # jax-free-host, event-loop-blocking, no-swallowed-cancellation —
+    # `# lint: allow(<rule>)` pragmas mark the audited exceptions), the
+    # append-only binary wire-schema contract against
+    # tests/testdata/wire_schema.json (regenerate DELIBERATELY with
+    # `python -m charon_tpu.analysis.schema_check --update`), and the
+    # app/metrics.py <-> docs/metrics.md catalogue sync. Everything
+    # here is jax-free and runs on any host. The analysis test battery
+    # (rule fixtures, sanitizer deadlock/leak scenarios, checker teeth)
+    # rides the normal fast tier in tests/test_analysis_*.py.
+    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py
+    python -m charon_tpu.analysis.schema_check
+    exec python -m charon_tpu.analysis.metrics_check
     ;;
   hostplane)
     # Wall-clock budget: ~60 s. Tiny shapes, CPU, no jax: asserts the
@@ -78,6 +100,9 @@ case "$TIER" in
     "${PYTEST[@]}" tests/ -m 'slow or not slow' --continue-on-collection-errors
     python bench_hostplane.py --smoke --cold-start
     python bench_wire.py --smoke
+    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py
+    python -m charon_tpu.analysis.schema_check
+    python -m charon_tpu.analysis.metrics_check
     exec python obs_check.py
     ;;
   obs)

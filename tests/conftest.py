@@ -73,3 +73,49 @@ def _isolate_process_globals():
     _fs._min_status, _fs._enabled, _fs._disabled = fs_state
     _tbls._current = tbls_impl
     _fi._plane = fi_plane
+
+
+# -- thread/task leak guard (ISSUE 10 satellite) -----------------------------
+#
+# The host-plane/chaos/cryptoplane suites spawn the system's real
+# concurrency (decode pools, device lanes, warm-up workers, dispatcher
+# tasks); a scenario that forgets close() leaks an idle executor thread
+# per test, and a task leaked past its asyncio.run surfaces only as an
+# easy-to-miss "Task was destroyed but it is pending!" stderr line.
+# Snapshot threads before each guarded test, and fail the TEST on
+# either signal (charon_tpu/analysis/sanitizer.py primitives).
+
+_LEAK_GUARDED_FILES = {
+    "test_hostplane.py",
+    "test_chaos_scenarios.py",
+    "test_cryptoplane.py",
+}
+
+
+@_pytest.fixture(autouse=True)
+def _thread_task_leak_guard(request):
+    fspath = getattr(request.node, "fspath", None)
+    name = fspath.basename if fspath is not None else ""
+    if name not in _LEAK_GUARDED_FILES:
+        yield
+        return
+    from charon_tpu.analysis import sanitizer as _san
+
+    before = _san.thread_snapshot()
+    watcher = _san.TaskDestroyedWatcher().install()
+    yield
+    destroyed = watcher.uninstall()
+    leaked = _san.check_thread_leaks(before, grace=5.0)
+    problems = []
+    if leaked:
+        problems.append(
+            f"leaked thread(s): {leaked} — an executor/worker outlived "
+            "the test (missing close()/shutdown())"
+        )
+    if destroyed:
+        problems.append(
+            f"{len(destroyed)} asyncio task(s) destroyed while pending "
+            f"(leaked past their loop): {destroyed[:3]}"
+        )
+    if problems:
+        _pytest.fail("; ".join(problems))

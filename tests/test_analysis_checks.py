@@ -1,0 +1,194 @@
+"""Schema-stability + metrics-catalogue checker batteries (ISSUE 10).
+
+The live registries must match their committed contracts (the
+acceptance half), and every class of contract break must be DETECTED
+when seeded against a mutated snapshot (the teeth half) — a checker
+that can't fail is documentation, not CI.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+from charon_tpu.analysis import metrics_check, schema_check
+
+# -- wire schema: acceptance -------------------------------------------------
+
+
+def test_live_codec_matches_committed_golden():
+    golden = json.loads(schema_check.GOLDEN.read_text())
+    errors = schema_check.compare(golden, schema_check.current_snapshot())
+    assert errors == [], "\n".join(errors)
+
+
+def test_golden_covers_every_hot_wire_id():
+    from charon_tpu.p2p import codec
+
+    golden = json.loads(schema_check.GOLDEN.read_text())
+    assert set(golden["types"]) == set(codec._TYPE_WIRE_IDS)
+    assert set(golden["enums"]) == set(codec._ENUM_WIRE_IDS)
+    # every hot type actually registered (an id without a schema would
+    # silently fall back to the cold JSON path)
+    for name, entry in golden["types"].items():
+        assert entry["fields"] is not None, f"{name} never registered"
+
+
+# -- wire schema: seeded violations ------------------------------------------
+
+
+def _mutate(fn):
+    golden = json.loads(schema_check.GOLDEN.read_text())
+    current = copy.deepcopy(golden)
+    fn(current)
+    return schema_check.compare(golden, current)
+
+
+def test_removed_type_detected():
+    errors = _mutate(lambda c: c["types"].pop("Duty"))
+    assert any("Duty" in e and "removed" in e for e in errors)
+
+
+def test_renumbered_id_detected():
+    def mut(c):
+        c["types"]["Duty"]["id"] = 99
+
+    errors = _mutate(mut)
+    assert any("renumbered" in e for e in errors)
+
+
+def test_reordered_fields_detected():
+    def mut(c):
+        f = c["types"]["ParSignedData"]["fields"]
+        f[0], f[1] = f[1], f[0]
+
+    errors = _mutate(mut)
+    assert any("append-only" in e for e in errors)
+
+
+def test_new_required_field_detected():
+    def mut(c):
+        t = c["types"]["Duty"]
+        t["fields"] = t["fields"] + ["epoch_hint"]
+        t["n_required"] = t["n_required"] + 1
+
+    errors = _mutate(mut)
+    assert any("REQUIRED" in e for e in errors)
+
+
+def test_appended_defaulted_field_is_allowed():
+    def mut(c):
+        c["types"]["Duty"]["fields"] = c["types"]["Duty"]["fields"] + [
+            "epoch_hint"
+        ]
+
+    assert _mutate(mut) == []
+
+
+def test_new_type_and_enum_allowed():
+    def mut(c):
+        c["types"]["FutureFrame"] = {
+            "id": 42, "fields": ["a"], "n_required": 1,
+        }
+        c["enums"]["FutureEnum"] = {"id": 9, "members": {"X": 1}}
+
+    assert _mutate(mut) == []
+
+
+def test_enum_member_removal_and_value_change_detected():
+    def mut(c):
+        m = c["enums"]["DutyType"]["members"]
+        m.pop("ATTESTER")
+        m["PROPOSER"] = 77
+
+    errors = _mutate(mut)
+    assert any("ATTESTER" in e and "removed" in e for e in errors)
+    assert any("PROPOSER" in e and "value changed" in e for e in errors)
+
+
+def test_duplicate_wire_id_detected():
+    def mut(c):
+        c["types"]["Evil"] = {
+            "id": c["types"]["Duty"]["id"], "fields": [], "n_required": 0,
+        }
+
+    errors = _mutate(mut)
+    assert any("collides" in e for e in errors)
+
+
+def test_duplicate_enum_wire_id_detected():
+    def mut(c):
+        c["enums"]["EvilEnum"] = {
+            "id": c["enums"]["DutyType"]["id"], "members": {"X": 1},
+        }
+
+    errors = _mutate(mut)
+    assert any("EvilEnum" in e and "collides" in e for e in errors)
+
+
+def test_required_default_flip_detected():
+    def mut(c):
+        c["types"]["Duty"]["n_required"] = max(
+            0, c["types"]["Duty"]["n_required"] - 1
+        )
+
+    errors = _mutate(mut)
+    assert any("required/default flip" in e for e in errors)
+
+
+# -- metrics catalogue: acceptance -------------------------------------------
+
+
+def test_metrics_registry_matches_docs():
+    registered = metrics_check.registered_families()
+    documented = metrics_check.documented_families()
+    errors = metrics_check.compare(registered, documented)
+    assert errors == [], "\n".join(errors)
+    assert len(registered) >= 40  # sanity: collect() saw the registry
+
+
+def test_docs_parser_skips_spans_and_promrated(tmp_path):
+    docs = tmp_path / "metrics.md"
+    docs.write_text(
+        "## Families\n"
+        "| family | type | labels | meaning |\n"
+        "|---|---|---|---|\n"
+        "| `core_x_total` | counter | — | x |\n"
+        "## promrated sidecar (separate process)\n"
+        "| `promrated_y` | gauge | — | y |\n"
+        "# Span catalogue\n"
+        "| `core.some_span` | span | — | z |\n"
+    )
+    assert metrics_check.documented_families(docs) == {
+        "core_x_total": "counter"
+    }
+
+
+# -- metrics catalogue: seeded drift -----------------------------------------
+
+
+def test_undocumented_family_detected():
+    registered = dict(metrics_check.registered_families())
+    registered["core_new_shiny_total"] = "counter"
+    errors = metrics_check.compare(
+        registered, metrics_check.documented_families()
+    )
+    assert any("core_new_shiny_total" in e and "missing" in e for e in errors)
+
+
+def test_dangling_doc_row_detected():
+    documented = dict(metrics_check.documented_families())
+    documented["core_ghost_seconds"] = "histogram"
+    errors = metrics_check.compare(
+        metrics_check.registered_families(), documented
+    )
+    assert any("core_ghost_seconds" in e and "no longer" in e for e in errors)
+
+
+def test_type_mismatch_detected():
+    registered = metrics_check.registered_families()
+    documented = dict(metrics_check.documented_families())
+    name = next(iter(registered))
+    documented[name] = "summary"
+    errors = metrics_check.compare(registered, documented)
+    assert any(name in e and "documented as" in e for e in errors)

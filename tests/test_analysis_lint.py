@@ -1,0 +1,496 @@
+"""Lint-rule batteries (ISSUE 10): every rule fires on a seeded bad
+fixture, stays quiet on the good twin, respects scope, and is silenced
+by an audited pragma — plus the acceptance gate: the real tree lints
+clean.
+
+Fixtures are inline snippets run through the framework directly (the
+linter never imports what it checks, so no fixture packages needed).
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from charon_tpu.analysis import lint
+from charon_tpu.analysis.rule_cancellation import SwallowedCancellation
+from charon_tpu.analysis.rule_jax_free import JaxFreeHost
+from charon_tpu.analysis.rule_loop_blocking import EventLoopBlocking
+from charon_tpu.analysis.rule_monotonic_clock import MonotonicClock
+from charon_tpu.analysis.rule_typed_errors import TypedErrors
+
+
+def run(src: str, relpath: str = "charon_tpu/core/fake.py", rules=None):
+    mod = lint.LintModule(textwrap.dedent(src), relpath=relpath)
+    return lint.check_module(mod, rules)
+
+
+def names(violations):
+    return [v.rule for v in violations]
+
+
+# -- monotonic-clock ---------------------------------------------------------
+
+
+def test_monotonic_flags_direct_call():
+    vs = run(
+        """
+        import time
+        def arm():
+            deadline = time.time() + 5
+            return deadline
+        """,
+        rules=[MonotonicClock()],
+    )
+    assert names(vs) == ["monotonic-clock"]
+    assert vs[0].line == 4
+
+
+def test_monotonic_flags_alias_and_from_import():
+    vs = run(
+        """
+        import time as _time
+        from time import time as wall
+        def f():
+            a = _time.time()
+            b = wall()
+            return a + b
+        """,
+        rules=[MonotonicClock()],
+    )
+    assert names(vs) == ["monotonic-clock"] * 2
+
+
+def test_monotonic_flags_default_arg_reference():
+    # passing time.time as a callback/default is the same hazard
+    vs = run(
+        """
+        import time
+        def gate(now=time.time):
+            return now()
+        """,
+        rules=[MonotonicClock()],
+    )
+    assert len(vs) == 1
+
+
+def test_monotonic_clean_on_monotonic_and_perf_counter():
+    vs = run(
+        """
+        import time
+        def f():
+            t0 = time.monotonic()
+            t1 = time.perf_counter()
+            return t1 - t0
+        """,
+        rules=[MonotonicClock()],
+    )
+    assert vs == []
+
+
+def test_monotonic_out_of_scope_file_ignored():
+    vs = run(
+        "import time\nx = time.time()\n",
+        relpath="charon_tpu/app/peerinfo.py",
+        rules=[MonotonicClock()],
+    )
+    assert vs == []
+
+
+def test_monotonic_pragma_same_line_and_line_above():
+    vs = run(
+        """
+        import time
+        def f():
+            a = time.time()  # lint: allow(monotonic-clock)
+            # lint: allow(monotonic-clock) — attribution edge
+            b = time.time()
+            c = time.time()
+            return a + b + c
+        """,
+        rules=[MonotonicClock()],
+    )
+    assert len(vs) == 1 and vs[0].line == 7
+
+
+# -- typed-errors ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("exc", ["ValueError", "RuntimeError", "Exception"])
+def test_typed_errors_flags_generic_raises(exc):
+    vs = run(
+        f"def f():\n    raise {exc}('boom')\n",
+        relpath="charon_tpu/p2p/fake.py",
+        rules=[TypedErrors()],
+    )
+    assert names(vs) == ["typed-errors"]
+
+
+def test_typed_errors_allows_domain_subclasses_and_reraise():
+    vs = run(
+        """
+        class CodecError(ValueError):
+            pass
+        def f():
+            raise CodecError("malformed")
+        def g():
+            try:
+                f()
+            except CodecError:
+                raise
+        """,
+        relpath="charon_tpu/p2p/fake.py",
+        rules=[TypedErrors()],
+    )
+    assert vs == []
+
+
+def test_typed_errors_scope_is_boundary_modules_only():
+    src = "def f():\n    raise ValueError('x')\n"
+    assert run(src, "charon_tpu/core/scheduler.py", [TypedErrors()]) == []
+    assert len(run(src, "charon_tpu/core/cryptosvc.py", [TypedErrors()])) == 1
+
+
+# -- jax-free-host -----------------------------------------------------------
+
+
+def test_jax_free_flags_module_scope_import():
+    vs = run(
+        "import jax\n",
+        relpath="charon_tpu/p2p/codec.py",
+        rules=[JaxFreeHost()],
+    )
+    assert names(vs) == ["jax-free-host"]
+
+
+def test_jax_free_flags_from_import_and_submodule():
+    vs = run(
+        "from jax import numpy as jnp\nimport jax.numpy\n",
+        relpath="charon_tpu/app/metrics.py",
+        rules=[JaxFreeHost()],
+    )
+    assert len(vs) == 2
+
+
+def test_jax_free_allows_guarded_and_function_scope_imports():
+    vs = run(
+        """
+        try:
+            import jax
+        except ImportError:
+            jax = None
+        from typing import TYPE_CHECKING
+        if TYPE_CHECKING:
+            import jax.numpy
+        def kernel():
+            import jax
+            return jax
+        """,
+        relpath="charon_tpu/p2p/codec.py",
+        rules=[JaxFreeHost()],
+    )
+    assert vs == []
+
+
+def test_jax_free_flags_module_scope_function_call_imports():
+    # the codec's `_register_core_types()` pattern: a module-scope call
+    # executes that function's imports at import time
+    vs = run(
+        """
+        def _register():
+            import jax
+            return jax
+        _register()
+        """,
+        relpath="charon_tpu/p2p/codec.py",
+        rules=[JaxFreeHost()],
+    )
+    assert names(vs) == ["jax-free-host"]
+
+
+def test_jax_free_docstring_marker_opts_in():
+    vs = run(
+        '"""Helpers for the bench. Deliberately jax-free."""\nimport jax\n',
+        relpath="charon_tpu/eth2util/fake.py",  # not in the explicit list
+        rules=[JaxFreeHost()],
+    )
+    assert len(vs) == 1
+
+
+def test_jax_free_transitive_chain(tmp_path):
+    root = tmp_path
+    pkg = root / "charon_tpu"
+    (pkg / "app").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "app" / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text("import jax\n")
+    target = pkg / "app" / "metrics.py"
+    target.write_text("from charon_tpu import helper\n")
+    mod = lint.LintModule(
+        target.read_text(), relpath=str(target), path=target
+    )
+    vs = lint.check_module(mod, [JaxFreeHost()])
+    assert len(vs) == 1
+    assert "charon_tpu.helper -> jax" in vs[0].message
+
+
+def test_jax_free_transitive_guarded_edge_is_soft(tmp_path):
+    root = tmp_path
+    pkg = root / "charon_tpu"
+    (pkg / "app").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "app" / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(
+        "try:\n    import jax\nexcept ImportError:\n    jax = None\n"
+    )
+    target = pkg / "app" / "metrics.py"
+    target.write_text("from charon_tpu import helper\n")
+    mod = lint.LintModule(
+        target.read_text(), relpath=str(target), path=target
+    )
+    assert lint.check_module(mod, [JaxFreeHost()]) == []
+
+
+# -- event-loop-blocking -----------------------------------------------------
+
+
+def test_loop_blocking_flags_time_sleep_and_tbls():
+    vs = run(
+        """
+        import time
+        from charon_tpu import tbls
+        async def f(items):
+            time.sleep(0.1)
+            ok = tbls.verify_batch(items)
+            return ok
+        """,
+        rules=[EventLoopBlocking()],
+    )
+    assert names(vs) == ["event-loop-blocking"] * 2
+
+
+def test_loop_blocking_flags_duck_typed_sync_verify():
+    vs = run(
+        """
+        async def f(self, duty, signed):
+            return self.verifier.verify(duty, signed)
+        """,
+        rules=[EventLoopBlocking()],
+    )
+    assert len(vs) == 1
+
+
+def test_loop_blocking_clean_on_awaited_and_executor_paths():
+    vs = run(
+        """
+        import asyncio
+        from charon_tpu import tbls
+        async def f(self, items):
+            ok = await self.plane.verify(items)
+            ok2 = await asyncio.get_running_loop().run_in_executor(
+                None, tbls.verify_batch, items
+            )
+            await asyncio.sleep(0.01)
+            return ok and ok2
+        """,
+        rules=[EventLoopBlocking()],
+    )
+    assert vs == []
+
+
+def test_loop_blocking_ignores_sync_defs_and_nested_sync_defs():
+    vs = run(
+        """
+        import time
+        from charon_tpu import tbls
+        def sync_path(items):
+            time.sleep(0.1)
+            return tbls.verify_batch(items)
+        async def f(items):
+            def decode():
+                return tbls.verify_batch(items)
+            return decode
+        """,
+        rules=[EventLoopBlocking()],
+    )
+    assert vs == []
+
+
+def test_loop_blocking_scope_is_core_only():
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    assert run(src, "charon_tpu/p2p/fake.py", [EventLoopBlocking()]) == []
+    assert len(run(src, "charon_tpu/core/x.py", [EventLoopBlocking()])) == 1
+
+
+# -- no-swallowed-cancellation -----------------------------------------------
+
+
+def test_cancellation_flags_bare_and_baseexception_swallows():
+    vs = run(
+        """
+        import asyncio
+        async def f(x):
+            while True:
+                try:
+                    await x()
+                except:
+                    continue
+        async def g(x):
+            try:
+                await x()
+            except BaseException:
+                pass
+        """,
+        rules=[SwallowedCancellation()],
+    )
+    assert names(vs) == ["no-swallowed-cancellation"] * 2
+
+
+def test_cancellation_flags_cancelled_error_swallow_without_cancel():
+    vs = run(
+        """
+        import asyncio
+        async def recv(x):
+            try:
+                await x()
+            except asyncio.CancelledError:
+                pass
+        """,
+        rules=[SwallowedCancellation()],
+    )
+    assert len(vs) == 1
+
+
+def test_cancellation_allows_reraise_and_except_exception():
+    vs = run(
+        """
+        import asyncio
+        async def f(x):
+            try:
+                await x()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # CancelledError is BaseException on 3.8+
+            try:
+                await x()
+            except BaseException:
+                cleanup = True
+                raise
+        """,
+        rules=[SwallowedCancellation()],
+    )
+    assert vs == []
+
+
+def test_cancellation_allows_cancel_then_await_idiom():
+    vs = run(
+        """
+        import asyncio
+        async def stop(self):
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        """,
+        rules=[SwallowedCancellation()],
+    )
+    assert vs == []
+
+
+def test_cancellation_nested_def_raise_is_not_a_reraise():
+    # a raise inside a closure DEFINED in the handler re-raises nothing
+    vs = run(
+        """
+        async def f(x):
+            try:
+                await x()
+            except BaseException:
+                def cb():
+                    raise RuntimeError("later")
+                schedule(cb)
+        """,
+        rules=[SwallowedCancellation()],
+    )
+    assert len(vs) == 1
+
+
+def test_cancellation_ignores_sync_functions():
+    vs = run(
+        """
+        def f(x):
+            try:
+                x()
+            except:
+                pass
+        """,
+        rules=[SwallowedCancellation()],
+    )
+    assert vs == []
+
+
+# -- framework ---------------------------------------------------------------
+
+
+def test_pragma_multiple_rules_one_comment():
+    vs = run(
+        """
+        import time
+        async def f():
+            time.sleep(time.time())  # lint: allow(monotonic-clock, event-loop-blocking)
+        """,
+        rules=[MonotonicClock(), EventLoopBlocking()],
+    )
+    assert vs == []
+
+
+def test_unknown_rule_cli_exit_2(capsys):
+    assert lint.main(["--rule", "nope", "charon_tpu"]) == 2
+
+
+def test_list_rules_cli(capsys):
+    assert lint.main(["--list-rules", "charon_tpu"]) == 0
+    out = capsys.readouterr().out
+    for rule in (
+        "monotonic-clock",
+        "typed-errors",
+        "jax-free-host",
+        "event-loop-blocking",
+        "no-swallowed-cancellation",
+    ):
+        assert rule in out
+
+
+def test_missing_lint_target_is_a_loud_error(tmp_path):
+    # a renamed/typo'd explicit target must fail the gate, not shrink it
+    with pytest.raises(FileNotFoundError):
+        lint.lint_paths([str(tmp_path / "renamed_bench.py")])
+    assert lint.main([str(tmp_path / "renamed_bench.py")]) == 2
+
+
+def test_syntax_error_reported_not_crashed(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    violations, n = lint.lint_paths([str(bad)])
+    assert n == 1
+    assert [v.rule for v in violations] == ["parse"]
+
+
+# -- THE acceptance gate: the real tree lints clean --------------------------
+
+
+def test_repo_tree_lints_clean():
+    """`python -m charon_tpu.analysis.lint charon_tpu/` exits 0 — every
+    violation is fixed or carries an audited pragma (ISSUE 10)."""
+    import pathlib
+
+    root = pathlib.Path(lint.__file__).resolve().parents[2]
+    targets = [str(root / "charon_tpu")]
+    for bench in ("bench_wire.py", "bench_hostplane.py"):
+        if (root / bench).exists():
+            targets.append(str(root / bench))
+    violations, n = lint.lint_paths(targets)
+    assert n > 100  # sanity: the walk actually saw the tree
+    assert violations == [], "\n".join(v.render() for v in violations)

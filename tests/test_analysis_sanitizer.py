@@ -1,0 +1,261 @@
+"""Concurrency-sanitizer battery (ISSUE 10): the lock-order tracker
+must catch a seeded inversion WITHOUT needing the deadlock interleaving
+to actually fire, the leak detectors must catch a seeded leaked thread
+and a task dropped past its loop, and none of it may false-positive on
+well-ordered / well-closed code — including the REAL host-plane locks
+(tpu_impl PointCache) under the production nesting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import pytest
+
+from charon_tpu.analysis.sanitizer import (
+    LockGraph,
+    LockOrderError,
+    TaskDestroyedWatcher,
+    TrackedLock,
+    check_task_leaks,
+    check_thread_leaks,
+    instrument_lock_attr,
+    task_snapshot,
+    thread_snapshot,
+)
+
+# -- lock-order tracker ------------------------------------------------------
+
+
+def test_two_lock_inversion_raises_instead_of_deadlocking():
+    g = LockGraph("t")
+    a = TrackedLock(threading.Lock(), "A", g)
+    b = TrackedLock(threading.Lock(), "B", g)
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    with pytest.raises(LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    msg = str(ei.value)
+    assert "A -> B" in msg and "B -> A" in msg  # the cycle, attributed
+    assert "first at" in msg  # acquisition sites named
+
+
+def test_three_lock_cycle_detected_across_threads():
+    g = LockGraph("t3")
+    locks = {
+        n: TrackedLock(threading.Lock(), n, g) for n in ("A", "B", "C")
+    }
+
+    def pair(x, y):
+        with locks[x]:
+            with locks[y]:
+                pass
+
+    for x, y in (("A", "B"), ("B", "C")):
+        t = threading.Thread(target=pair, args=(x, y))
+        t.start()
+        t.join()
+    with pytest.raises(LockOrderError):
+        pair("C", "A")
+
+
+def test_consistent_order_never_raises_and_survives_a_violation():
+    g = LockGraph("t")
+    a = TrackedLock(threading.Lock(), "A", g)
+    b = TrackedLock(threading.Lock(), "B", g)
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError):
+        with b:
+            with a:
+                pass
+    # the violating edge rolled back: well-ordered code keeps working
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    edges = {a: set(bs) for a, bs in g.edges().items() if bs}
+    assert edges == {"A": {"B"}}  # the violating B->A edge rolled back
+    g.check()  # stored graph stayed acyclic
+
+
+def test_reentrant_rlock_records_no_self_edge():
+    g = LockGraph("t")
+    r = TrackedLock(threading.RLock(), "R", g)
+    with r:
+        with r:
+            pass
+    assert g.edges() == {}
+
+
+def test_nonblocking_failed_acquire_not_held():
+    g = LockGraph("t")
+    inner = threading.Lock()
+    a = TrackedLock(inner, "A", g)
+    inner.acquire()  # someone else holds it
+    assert a.acquire(blocking=False) is False
+    inner.release()
+    with a:  # holder list stayed clean after the failed acquire
+        pass
+
+
+def test_asyncio_lock_inversion_raises():
+    async def main():
+        g = LockGraph("aio")
+        x = TrackedLock(asyncio.Lock(), "X", g)
+        y = TrackedLock(asyncio.Lock(), "Y", g)
+        async with x:
+            async with y:
+                pass
+        with pytest.raises(LockOrderError):
+            async with y:
+                async with x:
+                    pass
+
+    asyncio.run(main())
+
+
+def test_instrumented_point_caches_production_order_is_clean():
+    """Wrap the REAL tpu_impl PointCache locks the way a scenario test
+    would (coalescer decode order: pubkeys then messages) and drive the
+    production nesting — clean; then seed the inversion — caught."""
+    from charon_tpu.tbls.tpu_impl import PointCache
+
+    pub = PointCache(lambda k: ("pub", k), maxsize=8)
+    msg = PointCache(lambda k: ("msg", k), maxsize=8)
+    g = LockGraph("pointcaches")
+    instrument_lock_attr(pub, "_lock", "pointcache:pub", g)
+    instrument_lock_attr(msg, "_lock", "pointcache:msg", g)
+
+    # production decode path: each cache lock held alone, sequentially
+    assert pub(b"k1") == ("pub", b"k1")
+    assert msg(b"r1") == ("msg", b"r1")
+    g.check()
+
+    # a (hypothetical) bulk path holding pub while warming msg...
+    with pub._lock:
+        with msg._lock:
+            pass
+    # ...and the inverted nesting from another thread: caught
+    def inverted():
+        with msg._lock:
+            with pub._lock:
+                pass
+
+    err: list = []
+
+    def run():
+        try:
+            inverted()
+        except LockOrderError as e:
+            err.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert err and "pointcache" in str(err[0])
+
+
+# -- thread leaks ------------------------------------------------------------
+
+
+def test_leaked_thread_detected_and_clean_shutdown_passes():
+    before = thread_snapshot()
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="seeded-leak")
+    t.start()
+    leaked = check_thread_leaks(before, grace=0.2)
+    assert leaked == ["seeded-leak"]
+    stop.set()
+    t.join()
+    assert check_thread_leaks(before, grace=0.5) == []
+
+
+def test_executor_shutdown_drains_within_grace():
+    import concurrent.futures
+
+    before = thread_snapshot()
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=2, thread_name_prefix="sanitizer-pool"
+    )
+    pool.submit(lambda: None).result()
+    pool.shutdown(wait=False)
+    assert check_thread_leaks(before, grace=2.0) == []
+
+
+def test_unclosed_executor_is_a_leak():
+    import concurrent.futures
+
+    before = thread_snapshot()
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="sanitizer-orphan"
+    )
+    pool.submit(lambda: None).result()
+    leaked = check_thread_leaks(before, grace=0.2)
+    assert leaked and leaked[0].startswith("sanitizer-orphan")
+    pool.shutdown(wait=True)
+
+
+# -- asyncio task leaks ------------------------------------------------------
+
+
+def test_task_leaks_inside_running_loop():
+    async def main():
+        before = task_snapshot()
+
+        async def forever():
+            await asyncio.sleep(3600)
+
+        t = asyncio.get_running_loop().create_task(
+            forever(), name="seeded-task-leak"
+        )
+        await asyncio.sleep(0)
+        leaked = check_task_leaks(before)
+        assert leaked == ["seeded-task-leak"]
+        t.cancel()
+        try:
+            await t
+        except asyncio.CancelledError:
+            pass
+        assert check_task_leaks(before) == []
+
+    asyncio.run(main())
+
+
+def test_task_destroyed_watcher_catches_task_dropped_past_its_loop():
+    w = TaskDestroyedWatcher().install()
+    loop = asyncio.new_event_loop()
+    try:
+
+        async def forever():
+            await asyncio.sleep(3600)
+
+        task = loop.create_task(forever())
+        loop.call_soon(loop.stop)
+        loop.run_forever()  # task started, never finished
+    finally:
+        loop.close()
+    del task, loop
+    records = w.uninstall()
+    assert records, "pending-task destruction must be captured"
+
+
+def test_task_destroyed_watcher_quiet_on_clean_run():
+    w = TaskDestroyedWatcher().install()
+
+    async def main():
+        await asyncio.sleep(0)
+
+    asyncio.run(main())
+    assert w.uninstall() == []
