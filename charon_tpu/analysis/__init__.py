@@ -16,8 +16,14 @@ whoever remembered it. This package makes them enforcement, not lore:
   schema_check.py    append-only wire-schema contract for p2p/codec
                      against tests/testdata/wire_schema.json
   metrics_check.py   app/metrics.py <-> docs/metrics.md catalogue sync
+  jaxpr_check.py     device-graph analyzer (ISSUE 11): jaxpr invariant
+                     checks + kernel primitive-census golden against
+                     tests/testdata/kernel_manifest.json
 
-Everything here is deliberately jax-free (and lints itself for it): the
-`ci.sh analysis` tier must run on any host, including the jax-less CI
-images that already run bench_wire.py.
+Everything above jaxpr_check is deliberately jax-free (and lints itself
+for it): those gates run on any host, including the jax-less CI images
+that already run bench_wire.py. jaxpr_check is the one exception — it
+exists to TRACE the device graphs, so it needs jax (CPU-only, tracing
+never executes); `ci.sh analysis` skips it loudly when jax is absent
+and the jax-free gates still run.
 """

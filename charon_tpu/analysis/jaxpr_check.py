@@ -1,0 +1,541 @@
+"""Device-graph static analyzer: jaxpr invariants + kernel golden manifest.
+
+The half of the codebase that earns the speedups — the jitted device
+graphs behind blsops and the mesh plane — had no analysis story: a stray
+float promotion, an accidental host callback, or an off-bucket-ladder
+shape silently re-introduces the regressions PRs 3-7 paid for, and
+nothing in CI would notice until a (currently unavailable) TPU window
+measured the damage. This module traces every registered kernel family
+(ops/blsops kernel_families(), including the mesh program variants
+registered by parallel.mesh.register_analysis_families() and the
+ops/sswu.py / ops/decompress.py graphs those families wrap) with
+`jax.make_jaxpr` on canonical bucket-ladder shapes under
+JAX_PLATFORMS=cpu — tracing only, never executing — and enforces:
+
+  * no host callbacks (pure_callback / io_callback / debug_callback /
+    debug_print) inside hot kernels;
+  * no floating-point dtypes anywhere — the limb engine is integer-only
+    BY DESIGN, so a silent float32 promotion is a *correctness* bug
+    (24-bit limb products don't round-trip through f32), not just perf;
+  * no implicit convert_element_type widening of limb data beyond the
+    geometry's declared limb dtype (uint32 -> uint64/int64 on the TPU
+    geometry would silently fall back to XLA's slow emulated 64-bit
+    path); index/iota values (int32/int64 scalars XLA mints for gathers)
+    are exempt — only converts FROM the limb dtype count;
+  * every traced input shape sits on blsops.bucket_lanes's ladder (an
+    off-ladder shape means a caller bypassed the bucket discipline and
+    the jit cache will grow per flush size).
+
+Each family's primitive census (op counts, input/output avals, total
+eqn count) is recorded in tests/testdata/kernel_manifest.json — the
+device-graph twin of wire_schema.json. Any change that unfuses a fused
+kernel, explodes a gather, or adds an unexpected transpose fails CI
+with a named per-primitive diff; `--update` re-blesses deliberate
+changes.
+
+Cost model (1-core CI): the pairing-family graphs are 150k-400k eqns
+and trace in 25-60 s EACH, so retracing everything per run would blow
+the analysis tier's budget ~10x. A jaxpr is a pure function of the
+graph-defining sources + the jax version, so the manifest records a
+digest over charon_tpu/ops/*.py + charon_tpu/parallel/mesh.py: when
+the digest matches, the heavy families cannot have drifted and only the
+cheap `sentinel` families (seconds total; they cover both limb
+geometries) are re-traced for live teeth. A digest mismatch — someone
+actually edited kernel code — triggers the full retrace and census
+compare. `--full` forces it; `ci.sh full` runs it.
+
+Usage:
+    python -m charon_tpu.analysis.jaxpr_check            # sentinel+digest
+    python -m charon_tpu.analysis.jaxpr_check --full     # retrace all
+    python -m charon_tpu.analysis.jaxpr_check --update   # re-bless
+    python -m charon_tpu.analysis.jaxpr_check --list     # inventory
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+
+# Trace on CPU regardless of attached accelerators: the census must be
+# identical on every host, and tracing never needs the device anyway.
+# Only effective if jax has not initialized yet — when it has, the
+# guard in gather_families() rejects non-CPU backends with a clear
+# error instead of silently producing platform-dependent censuses
+# (limb.default_fp_ctx() is geometry-per-platform).
+if "jax" not in sys.modules:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+_REPO = Path(__file__).resolve().parents[2]
+MANIFEST_PATH = _REPO / "tests" / "testdata" / "kernel_manifest.json"
+
+# Sources the traced graphs are a pure function of (plus jax version):
+# editing anything here invalidates the digest fast path.
+GRAPH_SOURCE_GLOBS = (
+    ("charon_tpu/ops", "*.py"),
+    ("charon_tpu/parallel", "mesh.py"),
+)
+
+HOST_CALLBACK_PRIMS = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "debug_print",
+        "callback",
+        "host_callback",
+        "outside_call",
+    }
+)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking + census
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(value):
+    """Yield every Jaxpr nested in an eqn param value (pjit/scan carry
+    ClosedJaxpr, shard_map carries Jaxpr, cond carries tuples of them)."""
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if hasattr(v, "eqns"):
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            stack.extend(v)
+
+
+def walk_eqns(jaxpr):
+    """Depth-first over every equation, recursing through call/control
+    primitives — the flattened device graph the checks run on."""
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                stack.extend(_sub_jaxprs(v))
+
+
+def _aval_str(aval) -> str:
+    return f"{aval.dtype}[{','.join(str(d) for d in aval.shape)}]"
+
+
+def census_of(closed_jaxpr, spec) -> dict:
+    """Primitive census: the manifest record for one traced family."""
+    prims: dict[str, int] = {}
+    n_eqns = 0
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        n_eqns += 1
+        prims[eqn.primitive.name] = prims.get(eqn.primitive.name, 0) + 1
+    return {
+        "lanes": spec.lanes,
+        "multiple": spec.multiple,
+        "ctx": spec.ctx.name,
+        "dtype": str(spec.ctx.dtype),
+        "eqns": n_eqns,
+        "in_avals": [_aval_str(v.aval) for v in closed_jaxpr.jaxpr.invars],
+        "out_avals": [_aval_str(v.aval) for v in closed_jaxpr.jaxpr.outvars],
+        "prims": dict(sorted(prims.items())),
+    }
+
+
+# ---------------------------------------------------------------------------
+# invariant checks
+# ---------------------------------------------------------------------------
+
+
+def check_jaxpr(name: str, closed_jaxpr, spec) -> list[str]:
+    """The four device-graph invariants. Returns violation strings
+    prefixed with the kernel family name — empty means clean."""
+    import numpy as np
+
+    from charon_tpu.ops import blsops
+
+    out: list[str] = []
+    limb_dtype = np.dtype(spec.ctx.np_dtype)
+
+    callback_hits: dict[str, int] = {}
+    float_hits: dict[str, int] = {}
+    widen_hits: dict[str, int] = {}
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        pname = eqn.primitive.name
+        if pname in HOST_CALLBACK_PRIMS:
+            callback_hits[pname] = callback_hits.get(pname, 0) + 1
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                if np.issubdtype(aval.dtype, np.floating) or np.issubdtype(
+                    aval.dtype, np.complexfloating
+                ):
+                    key = f"{pname}:{aval.dtype}"
+                    float_hits[key] = float_hits.get(key, 0) + 1
+        if pname == "convert_element_type":
+            src = np.dtype(eqn.invars[0].aval.dtype)
+            dst = np.dtype(eqn.params["new_dtype"])
+            # widening of LIMB data past the declared geometry; index
+            # dtypes (signed ints not equal to the limb dtype) and
+            # bool masks are exempt
+            if (
+                src == limb_dtype
+                and dst.kind in "iu"
+                and dst.itemsize > limb_dtype.itemsize
+            ):
+                key = f"{src}->{dst}"
+                widen_hits[key] = widen_hits.get(key, 0) + 1
+    for pname, cnt in sorted(callback_hits.items()):
+        out.append(
+            f"{name}: host callback {pname} x{cnt} inside a hot kernel "
+            "(device graphs must never re-enter the host)"
+        )
+    for key, cnt in sorted(float_hits.items()):
+        out.append(
+            f"{name}: floating-point aval {key} x{cnt} (the limb engine "
+            "is integer-only by design — a float promotion is a "
+            "correctness bug)"
+        )
+    for key, cnt in sorted(widen_hits.items()):
+        out.append(
+            f"{name}: convert_element_type {key} x{cnt} widens limb data "
+            f"beyond the declared {limb_dtype} geometry"
+        )
+
+    # bucket-ladder shapes: declared lanes must be a ladder member, and
+    # every array input's batch dim must sit on it
+    if spec.lanes != blsops.bucket_lanes(spec.lanes, spec.multiple):
+        out.append(
+            f"{name}: canonical lanes {spec.lanes} off the bucket ladder "
+            f"(bucket_lanes -> {blsops.bucket_lanes(spec.lanes, spec.multiple)})"
+        )
+    else:
+        for i, v in enumerate(closed_jaxpr.jaxpr.invars):
+            aval = getattr(v, "aval", None)
+            if aval is None or not getattr(aval, "shape", ()):
+                continue
+            if aval.shape[0] != spec.lanes:
+                out.append(
+                    f"{name}: input {i} batch dim {aval.shape[0]} != "
+                    f"declared ladder lanes {spec.lanes} "
+                    f"({_aval_str(aval)})"
+                )
+    return out
+
+
+def analyze_family(name: str, fam) -> tuple[dict, list[str]]:
+    """Build the family's canonical TraceSpec and trace it (never
+    executes). Returns (census, violations)."""
+    import jax
+
+    spec = fam.build()
+    closed = jax.make_jaxpr(spec.fn)(*spec.args)
+    cens = census_of(closed, spec)
+    cens["sentinel"] = bool(fam.sentinel)
+    violations = check_jaxpr(name, closed, spec)
+    del closed  # the big graphs are hundreds of MB — drop eagerly
+    return cens, violations
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def graph_source_files(repo: Path = _REPO) -> list[Path]:
+    files: list[Path] = []
+    for rel, pattern in GRAPH_SOURCE_GLOBS:
+        base = repo / rel
+        files.extend(
+            p
+            for p in sorted(base.glob(pattern))
+            if "__pycache__" not in p.parts
+        )
+    return files
+
+
+def source_digest(repo: Path = _REPO) -> str:
+    import jax
+
+    h = hashlib.sha256()
+    h.update(f"jax={jax.__version__}".encode())
+    for p in graph_source_files(repo):
+        h.update(p.relative_to(repo).as_posix().encode())
+        h.update(b"\0")
+        h.update(p.read_bytes())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def load_manifest(path: Path = MANIFEST_PATH) -> dict | None:
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def write_manifest(data: dict, path: Path = MANIFEST_PATH) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=1, sort_keys=True) + "\n")
+
+
+def diff_census(name: str, golden: dict, current: dict) -> list[str]:
+    """Named per-primitive diff — the CI failure message IS the review
+    artifact for a deliberate kernel change."""
+    out: list[str] = []
+    for field in ("lanes", "multiple", "ctx", "dtype", "eqns"):
+        if golden.get(field) != current.get(field):
+            out.append(
+                f"{name}: {field} {golden.get(field)} -> {current.get(field)}"
+            )
+    for field in ("in_avals", "out_avals"):
+        if golden.get(field) != current.get(field):
+            out.append(
+                f"{name}: {field} {golden.get(field)} -> "
+                f"{current.get(field)}"
+            )
+    gp, cp = golden.get("prims", {}), current.get("prims", {})
+    for prim in sorted(set(gp) | set(cp)):
+        a, b = gp.get(prim, 0), cp.get(prim, 0)
+        if a != b:
+            out.append(f"{name}: prim {prim} {a} -> {b} ({b - a:+d})")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def gather_families() -> dict:
+    """The full registry: engine families (blsops import) + the mesh
+    plane variants (registered here). Refuses to run against a non-CPU
+    jax backend — the manifest censuses are blessed on CPU and
+    limb.default_fp_ctx() is geometry-per-platform, so tracing
+    elsewhere would diff against the wrong golden."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "cpu":
+        raise RuntimeError(
+            f"jaxpr_check must trace on CPU but jax already initialized "
+            f"backend {backend!r} in this process — run it in a fresh "
+            "process (JAX_PLATFORMS=cpu)"
+        )
+    from charon_tpu.ops import blsops
+    from charon_tpu.parallel import mesh
+
+    mesh.register_analysis_families()
+    return blsops.kernel_families()
+
+
+def run_check(
+    families: dict,
+    manifest: dict | None,
+    *,
+    full: bool = False,
+    update: bool = False,
+    only: list[str] | None = None,
+    digest: str | None = None,
+    progress=None,
+) -> tuple[list[str], dict, int]:
+    """Core engine shared by the CLI and the test batteries.
+
+    Returns (failures, new_manifest_families, traced_count). `only`
+    restricts tracing to the named families (no registry/golden
+    completeness checks — the targeted test/debug mode)."""
+    failures: list[str] = []
+    traced: dict[str, dict] = {}
+
+    if only is not None:
+        unknown = set(only) - set(families)
+        if unknown:
+            raise KeyError(f"unknown kernel families: {sorted(unknown)}")
+        to_trace = {n: families[n] for n in only}
+    else:
+        golden_fams = (manifest or {}).get("families", {})
+        for name in sorted(set(golden_fams) - set(families)):
+            # in update mode the rewritten manifest simply omits the
+            # family — that IS the re-bless, not a violation
+            if not update:
+                failures.append(
+                    f"{name}: in kernel_manifest.json but no longer "
+                    "registered (removed kernel families must be "
+                    "re-blessed with --update)"
+                )
+        for name in sorted(set(families) - set(golden_fams)):
+            if not update:
+                failures.append(
+                    f"{name}: registered but missing from "
+                    "kernel_manifest.json (bless new families with "
+                    "--update)"
+                )
+        digest_ok = (
+            manifest is not None
+            and digest is not None
+            and manifest.get("source_digest") == digest
+            and manifest.get("jax_version") == _jax_version()
+        )
+        if full or update or not digest_ok:
+            to_trace = dict(families)
+        else:
+            to_trace = {
+                n: f for n, f in families.items() if f.sentinel
+            }
+
+    for name in sorted(to_trace):
+        if progress:
+            progress(name)
+        cens, violations = analyze_family(name, to_trace[name])
+        traced[name] = cens
+        failures.extend(violations)
+        golden = (manifest or {}).get("families", {}).get(name)
+        if golden is not None and not update:
+            failures.extend(diff_census(name, golden, cens))
+    return failures, traced, len(traced)
+
+
+def _jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="charon_tpu.analysis.jaxpr_check",
+        description="device-graph invariant checks + kernel golden manifest",
+    )
+    ap.add_argument(
+        "--full",
+        action="store_true",
+        help="retrace every family (default: sentinels + source digest)",
+    )
+    ap.add_argument(
+        "--update",
+        action="store_true",
+        help="retrace everything and re-bless the golden manifest",
+    )
+    ap.add_argument(
+        "--family",
+        action="append",
+        default=None,
+        help="trace only this family (repeatable; skips completeness checks)",
+    )
+    ap.add_argument(
+        "--manifest",
+        default=str(MANIFEST_PATH),
+        help="golden manifest path (tests override)",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="print the kernel inventory"
+    )
+    args = ap.parse_args(argv)
+
+    if args.update and args.family:
+        # run_check(only=...) skips golden diffs and main() never
+        # writes a partial manifest — the combination would exit 0
+        # having blessed NOTHING, which reads as success
+        print(
+            "--update re-blesses the WHOLE manifest and cannot be "
+            "combined with --family",
+            file=sys.stderr,
+        )
+        return 2
+
+    families = gather_families()
+    if args.list:
+        for name, fam in sorted(families.items()):
+            print(f"{'sentinel' if fam.sentinel else 'digest  '} {name}")
+        return 0
+
+    manifest_path = Path(args.manifest)
+    manifest = load_manifest(manifest_path)
+    digest = source_digest()
+    if manifest is None and not args.update:
+        print(
+            f"no golden manifest at {manifest_path} — generate one with "
+            "--update",
+            file=sys.stderr,
+        )
+        return 1
+
+    retracing_all = args.full or args.update or (
+        manifest is not None
+        and (
+            manifest.get("source_digest") != digest
+            or manifest.get("jax_version") != _jax_version()
+        )
+    )
+    if retracing_all and not (args.full or args.update):
+        print(
+            "kernel sources (or jax) changed since the manifest was "
+            "blessed — full retrace (25-60 s per pairing family on one "
+            "core)",
+            file=sys.stderr,
+        )
+
+    failures, traced, n = run_check(
+        families,
+        manifest,
+        full=args.full,
+        update=args.update,
+        only=args.family,
+        digest=digest,
+        progress=lambda name: print(f"tracing {name}", file=sys.stderr),
+    )
+    for f in failures:
+        print(f)
+
+    if args.update and not args.family:
+        if failures:
+            print(
+                "refusing to bless a manifest over live violations",
+                file=sys.stderr,
+            )
+            return 1
+        write_manifest(
+            {
+                "version": 1,
+                "jax_version": _jax_version(),
+                "source_digest": digest,
+                "source_files": [
+                    p.relative_to(_REPO).as_posix()
+                    for p in graph_source_files()
+                ],
+                "families": traced,
+            },
+            manifest_path,
+        )
+        print(
+            f"blessed {len(traced)} families into {manifest_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if (
+        not failures
+        and retracing_all
+        and manifest is not None
+        and manifest.get("source_digest") != digest
+    ):
+        print(
+            "censuses all match but the source digest is stale — run "
+            "--update to restore the sentinel fast path",
+            file=sys.stderr,
+        )
+    covered = len(families) if args.family is None else n
+    print(
+        f"{len(failures)} violation(s); {n} traced / {covered} families "
+        f"covered",
+        file=sys.stderr,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,13 +57,38 @@ class LintModule:
         self.path = path
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
+        # Pragmas are COMMENT tokens only: a docstring that *mentions*
+        # the syntax (every rule module documents it) must neither
+        # allowlist its own line nor pollute the --pragmas audit.
         self._allow: dict[int, set[str]] = {}
-        for i, ln in enumerate(self.lines, 1):
-            m = _PRAGMA_RE.search(ln)
-            if m:
-                self._allow[i] = {
-                    r.strip() for r in m.group(1).split(",") if r.strip()
-                }
+        import io
+        import tokenize
+
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline
+            ):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if m:
+                    self._allow.setdefault(tok.start[0], set()).update(
+                        r.strip()
+                        for r in m.group(1).split(",")
+                        if r.strip()
+                    )
+        except tokenize.TokenError:
+            # ast.parse succeeded, so this is unreachable in practice;
+            # fall back to the plain line scan rather than dropping
+            # every pragma in the file
+            for i, ln in enumerate(self.lines, 1):
+                m = _PRAGMA_RE.search(ln)
+                if m:
+                    self._allow[i] = {
+                        r.strip()
+                        for r in m.group(1).split(",")
+                        if r.strip()
+                    }
         # import x [as y]  ->  {y_or_x_head: "x"}   (full dotted module)
         # from m import a [as b]  ->  {b_or_a: "m:a"}
         self.imports: dict[str, str] = {}
@@ -158,6 +183,7 @@ def all_rules() -> list[Rule]:
     from charon_tpu.analysis.rule_jax_free import JaxFreeHost
     from charon_tpu.analysis.rule_loop_blocking import EventLoopBlocking
     from charon_tpu.analysis.rule_monotonic_clock import MonotonicClock
+    from charon_tpu.analysis.rule_secret_flow import SecretFlow
     from charon_tpu.analysis.rule_typed_errors import TypedErrors
 
     return [
@@ -166,6 +192,7 @@ def all_rules() -> list[Rule]:
         JaxFreeHost(),
         EventLoopBlocking(),
         SwallowedCancellation(),
+        SecretFlow(),
     ]
 
 
@@ -227,6 +254,32 @@ def lint_paths(
     return violations, n
 
 
+def audit_pragmas(
+    paths: Iterable[str],
+) -> list[tuple[str, str, int, str]]:
+    """Inventory of every `# lint: allow(...)` pragma under `paths`:
+    (rule, posix path, line, stripped source line). The allowlist PR 10
+    introduced was write-only — pragmas accreted but nothing listed
+    them for review. This is the reviewable ledger: one row per
+    (rule, site), sorted by rule then location. Git-blame-free by
+    design — the listing itself is the audit surface."""
+    out: list[tuple[str, str, int, str]] = []
+    for f in iter_py_files(paths):
+        rel = f.as_posix()
+        try:
+            mod = LintModule(
+                f.read_text(encoding="utf-8"), relpath=rel, path=f
+            )
+        except SyntaxError:
+            continue  # the lint pass itself reports parse errors
+        for line, rules in sorted(mod._allow.items()):
+            snippet = mod.lines[line - 1].strip()
+            for rule in sorted(rules):
+                out.append((rule, mod.relpath, line, snippet))
+    out.sort(key=lambda r: (r[0], r[1], r[2]))
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -244,12 +297,36 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue"
     )
+    ap.add_argument(
+        "--pragmas",
+        action="store_true",
+        help="audit report: list every `# lint: allow(...)` pragma "
+        "with rule, file:line, and the allowed source line",
+    )
     args = ap.parse_args(argv)
 
     rules = all_rules()
     if args.list_rules:
         for r in rules:
             print(f"{r.name}: {r.description}")
+        return 0
+    if args.pragmas:
+        try:
+            entries = audit_pragmas(args.paths)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        counts: dict[str, int] = {}
+        for rule, rel, line, snippet in entries:
+            counts[rule] = counts.get(rule, 0) + 1
+            print(f"{rule}: {rel}:{line}: {snippet}")
+        summary = ", ".join(
+            f"{r}={n}" for r, n in sorted(counts.items())
+        )
+        print(
+            f"{len(entries)} pragma(s) [{summary or 'none'}]",
+            file=sys.stderr,
+        )
         return 0
     if args.rule:
         known = {r.name for r in rules}

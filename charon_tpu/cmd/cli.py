@@ -860,6 +860,8 @@ def cmd_combine(args) -> int:
             return 1
         secrets.append(secret)
         pubkeys.append(want)
+    # `recover` EXISTS to write the combined keys back out as
+    # encrypted EIP-2335 keystores  # lint: allow(secret-flow)
     keystore.store_keys(secrets, out, pubkeys=pubkeys)
     print(f"recovered {v} validator key(s) into {out}")
     return 0
@@ -1142,6 +1144,8 @@ def cmd_alpha(args) -> int:
             (r.secret_share % (1 << 256)).to_bytes(32, "big")
             for r in per_node_results[i]
         ]
+        # add-validators writes the new shares as encrypted keystores
+        # next to the existing set  # lint: allow(secret-flow)
         keystore.store_keys(
             share_secrets,
             d / "validator_keys",

@@ -166,6 +166,32 @@ class PlaneConfigError(ValueError):
     wire/crypto failures — it is a deploy bug, never degradable load)."""
 
 
+def kernel_inventory() -> dict:
+    """Machine-readable inventory of every registered device kernel
+    family behind this plane (ISSUE 11): the blsops engine kernels plus
+    the mesh program variants, registered on canonical bucket-ladder
+    shapes. Consumers: the jaxpr static analyzer
+    (charon_tpu/analysis/jaxpr_check.py traces each family and gates
+    its primitive census against tests/testdata/kernel_manifest.json)
+    and the future per-platform auto-tuner (ROADMAP item 3 enumerates
+    candidates from the same registry). Raises PlaneConfigError on a
+    jax-less host (asking for the device inventory without jax is a
+    deploy/config mistake) — inventory is an analysis/tuning surface,
+    not a duty-path one."""
+    if _dec is None:
+        raise PlaneConfigError(
+            "kernel inventory requires jax (ops import failed)"
+        )
+    from charon_tpu.ops import blsops
+    from charon_tpu.parallel import mesh as _mesh
+
+    _mesh.register_analysis_families()
+    return {
+        name: {"sentinel": fam.sentinel}
+        for name, fam in sorted(blsops.kernel_families().items())
+    }
+
+
 def _decode_pubkey(pk: bytes):
     from charon_tpu.tbls.tpu_impl import _cached_pubkey_point
 

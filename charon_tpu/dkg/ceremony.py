@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 
 from charon_tpu import tbls
@@ -26,7 +26,9 @@ from charon_tpu.eth2util import keystore
 @dataclass
 class DKGResult:
     lock: ClusterLock
-    share_secrets: list[bytes]  # this node's share key per validator (32B)
+    # repr=False: the auto-repr would dump every validator's share key
+    # into any log/traceback formatting the result (secret-flow lint)
+    share_secrets: list[bytes] = field(repr=False)  # per validator (32B)
     deposits: list = None  # eth2util.deposit.DepositData per validator
 
 
@@ -267,6 +269,9 @@ async def run_dkg(
         data_dir = Path(data_dir)
         data_dir.mkdir(parents=True, exist_ok=True)
         lock.save(str(data_dir / "cluster-lock.json"))
+        # keystore I/O IS the ceremony's output: shares leave only as
+        # EIP-2335-encrypted keystores
+        # lint: allow(secret-flow)
         keystore.store_keys(
             share_secrets,
             data_dir / "validator_keys",

@@ -45,15 +45,21 @@ class Round1Broadcast:
 class Round1Shares:
     """Secret Shamir shares f_i(j) this participant sends to peer j,
     one per validator ceremony. MUST go over an authenticated private
-    channel (the reference sends them via libp2p streams, frostp2p.go)."""
+    channel (the reference sends them via libp2p streams, frostp2p.go).
 
-    shares: tuple  # num_validators scalars
+    repr=False: the auto-repr would dump raw share scalars into any log
+    line, traceback, or asyncio "Task exception was never retrieved"
+    report that formats the object (secret-flow lint finding)."""
+
+    shares: tuple = field(repr=False)  # num_validators scalars
 
 
 @dataclass(frozen=True)
 class FrostResult:
     group_pubkey: object  # G1 affine
-    secret_share: int  # this node's share of the group secret
+    # repr=False: a formatted FrostResult must show WHICH ceremony it
+    # is, never the long-lived secret share (secret-flow lint finding)
+    secret_share: int = field(repr=False)  # this node's share
     pubshares: dict  # share_idx -> G1 affine pubshare
 
 

@@ -169,6 +169,11 @@ class TcpFrostPort:
         for share_idx_1b, sh in shares.items():
             to0 = share_idx_1b - 1
             if to0 != tx.idx:
+                # THE sealed share channel: served only to its addressee
+                # (_on_req private_to gate) over the per-frame AES-GCM
+                # transport, mirroring the reference's private libp2p
+                # share streams (frostp2p.go)
+                # lint: allow(secret-flow)
                 tx.publish(f"frost-r1-shares:{to0}", sh, private_to=to0)
         all_b = await tx.gather("frost-r1-bcast", list(broadcasts))
         my_shares = {tx.idx + 1: shares[tx.idx + 1]}

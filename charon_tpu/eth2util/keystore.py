@@ -109,6 +109,8 @@ def store_keys(
             path=f"m/12381/3600/{i}/0/0",
         )
         (directory / f"keystore-{i}.json").write_text(json.dumps(ks, indent=2))
+        # the EIP-2335 sidecar password file is the keystore format's
+        # own contract (ref: keystore.go)  # lint: allow(secret-flow)
         (directory / f"keystore-{i}.txt").write_text(password)
 
 

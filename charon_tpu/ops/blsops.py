@@ -17,7 +17,9 @@ Identity encoding: affine (0, 0) lanes are group identities throughout
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+from typing import Callable
 
 import numpy as np
 import jax
@@ -81,6 +83,244 @@ def jit_cache_size() -> int:
     kernels. Bounded by (kernel families) x (bucket-ladder shapes) —
     asserted in tests/test_hostplane.py across random-size flushes."""
     return sum(k._cache_size() for k in _JIT_KERNELS)
+
+
+# ---------------------------------------------------------------------------
+# Named kernel-family registry (ISSUE 11)
+# ---------------------------------------------------------------------------
+#
+# _JIT_KERNELS above counts compiled programs but is anonymous — it can
+# tell you HOW MANY programs exist, not WHICH. The named registry below
+# is the machine-readable kernel inventory: every device-graph family
+# registers a build closure that returns a traceable (fn, canonical
+# args) pair on bucket-ladder shapes, so the static analyzer
+# (charon_tpu/analysis/jaxpr_check.py) can jax.make_jaxpr each family
+# WITHOUT executing it, and the future per-platform auto-tuner
+# (ROADMAP item 3) can enumerate candidates. Registration is cheap
+# (closures only); canonical inputs are built lazily at trace time.
+
+
+@dataclasses.dataclass
+class TraceSpec:
+    """One traceable instantiation of a kernel family: the callable,
+    canonical example args on a bucket-ladder shape, and the limb
+    geometry the analyzer checks dtype invariants against."""
+
+    fn: Callable
+    args: tuple
+    ctx: "ModCtx"
+    lanes: int  # padded batch lanes (must sit on the bucket ladder)
+    multiple: int = 1  # ladder multiple (mesh shard count; 1 = engine)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelFamily:
+    """A registered device-graph family. `build()` -> TraceSpec.
+
+    `sentinel` families are cheap to trace (~seconds) and are re-traced
+    on EVERY `ci.sh analysis` run; non-sentinel families (the pairing
+    graphs trace in 25-45 s each on one core) are covered by the
+    manifest source digest and re-traced only when kernel sources
+    change (jaxpr_check --full / --update)."""
+
+    name: str
+    build: Callable[[], TraceSpec]
+    sentinel: bool = False
+
+
+_KERNEL_FAMILIES: dict[str, KernelFamily] = {}
+
+
+def register_kernel_family(
+    name: str, build: Callable[[], TraceSpec], sentinel: bool = False
+) -> None:
+    if name in _KERNEL_FAMILIES:
+        raise ValueError(f"kernel family {name!r} already registered")
+    _KERNEL_FAMILIES[name] = KernelFamily(name, build, sentinel)
+
+
+def kernel_families() -> dict[str, KernelFamily]:
+    """Snapshot of the registry (engine families at import time; mesh
+    plane variants after parallel.mesh.register_analysis_families())."""
+    return dict(_KERNEL_FAMILIES)
+
+
+def _register_engine_families() -> None:
+    """Register this module's kernel families on canonical shapes.
+
+    Canonical lanes = 4 (the ladder floor) keeps trace time minimal —
+    the primitive census is shape-stable per family, so one ladder
+    point pins the graph. Both limb geometries register for the cheap
+    families: the uint32 (TPU) geometry is where a stray 64-bit
+    widening or float promotion would actually hurt, so the sentinels
+    cover it every run."""
+    from charon_tpu.ops import curve as _C
+
+    def _pts(ctx, n):
+        from charon_tpu.crypto.g1g2 import G1_GEN, G2_GEN
+
+        return (
+            _C.g1_pack(ctx, [G1_GEN] * n),
+            _C.g2_pack(ctx, [G2_GEN] * n),
+            _C.g2_pack(ctx, [G2_GEN] * n),
+        )
+
+    def _grid(tree, t):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.stack([a] * t, axis=1), tree
+        )
+
+    n = 4
+    t = 3
+
+    def _verify(ctx, fr_ctx):
+        pk, msg, sig = _pts(ctx, n)
+        return TraceSpec(_verify_kernel(ctx), (pk, msg, sig), ctx, n)
+
+    def _verify_rlc(ctx, fr_ctx):
+        pk, msg, sig = _pts(ctx, n)
+        rand = jnp.asarray(limb.ctx_pack(fr_ctx, [1] * n))
+        return TraceSpec(
+            _verify_rlc_kernel(ctx, fr_ctx), (pk, msg, sig, rand), ctx, n
+        )
+
+    def _verify_grouped(ctx, fr_ctx):
+        pk, msg, sig = _pts(ctx, n * n)
+        gridify = lambda tree: jax.tree_util.tree_map(
+            lambda a: a.reshape(n, n, *a.shape[1:]), tree
+        )
+        rand = jnp.asarray(
+            np.asarray(limb.ctx_pack(fr_ctx, [1] * (n * n))).reshape(
+                n, n, -1
+            )
+        )
+        return TraceSpec(
+            _verify_grouped_rlc_kernel(ctx, fr_ctx),
+            (gridify(pk), _pts(ctx, n)[1], gridify(sig), rand),
+            ctx,
+            n,
+        )
+
+    def _thr_agg(ctx, fr_ctx):
+        _, _, sig = _pts(ctx, n)
+        idx = jnp.asarray(
+            np.tile(np.arange(1, t + 1, dtype=np.int32), (n, 1))
+        )
+        return TraceSpec(
+            _threshold_agg_kernel(ctx, fr_ctx, t),
+            (_grid(sig, t), idx),
+            ctx,
+            n,
+        )
+
+    def _agg(ctx, fr_ctx):
+        _, _, sig = _pts(ctx, n)
+        return TraceSpec(_aggregate_kernel(ctx, t), (_grid(sig, t),), ctx, n)
+
+    def _g1sum(ctx, fr_ctx):
+        pk, _, _ = _pts(ctx, n)
+        return TraceSpec(_g1_sum_kernel(ctx, t), (_grid(pk, t),), ctx, n)
+
+    def _sub_g2(ctx, fr_ctx):
+        _, _, sig = _pts(ctx, n)
+        order = jnp.asarray(limb.ctx_pack(fr_ctx, [fr_ctx.modulus] * n))
+        return TraceSpec(
+            _subgroup_g2_kernel(ctx, fr_ctx), (sig, order), ctx, n
+        )
+
+    def _sub_g1(ctx, fr_ctx):
+        pk, _, _ = _pts(ctx, n)
+        order = jnp.asarray(limb.ctx_pack(fr_ctx, [fr_ctx.modulus] * n))
+        return TraceSpec(
+            _subgroup_g1_kernel(ctx, fr_ctx), (pk, order), ctx, n
+        )
+
+    def _dec_g2(ctx, fr_ctx):
+        from charon_tpu.crypto.g1g2 import G2_GEN, g2_to_bytes
+
+        parsed = [DEC.parse_g2_lane(g2_to_bytes(G2_GEN))] * n
+        return TraceSpec(
+            _decompress_g2_kernel(ctx, fr_ctx, True),
+            DEC.pack_parsed_g2(ctx, parsed),
+            ctx,
+            n,
+        )
+
+    def _dec_g1(ctx, fr_ctx):
+        from charon_tpu.crypto.g1g2 import G1_GEN, g1_to_bytes
+
+        parsed = [DEC.parse_g1_lane(g1_to_bytes(G1_GEN))] * n
+        return TraceSpec(
+            _decompress_g1_kernel(ctx, fr_ctx, True),
+            DEC.pack_parsed_g1(ctx, parsed),
+            ctx,
+            n,
+        )
+
+    def _h2c(ctx, fr_ctx):
+        lanes = [SSWU.hash_to_field_lane(b"jaxpr-check", SSWU.DST_POP)] * n
+        return TraceSpec(
+            _hash_to_g2_kernel(ctx, fr_ctx),
+            SSWU.pack_hashed(ctx, lanes),
+            ctx,
+            n,
+        )
+
+    def _g1_mul(ctx, fr_ctx):
+        pk, _, _ = _pts(ctx, n)
+        s = _C.fr_pack(fr_ctx, [1] * n)
+        return TraceSpec(
+            _g1_scalar_mul_kernel(ctx, fr_ctx), (pk, s), ctx, n
+        )
+
+    def _g2_mul(ctx, fr_ctx):
+        _, _, sig = _pts(ctx, n)
+        s = _C.fr_pack(fr_ctx, [1] * n)
+        return TraceSpec(
+            _g2_scalar_mul_kernel(ctx, fr_ctx), (sig, s), ctx, n
+        )
+
+    heavy = {
+        "verify": _verify,
+        "verify_rlc": _verify_rlc,
+        "verify_grouped_rlc": _verify_grouped,
+        "threshold_agg": _thr_agg,
+        "hash_to_g2": _h2c,
+    }
+    cheap = {
+        "aggregate": _agg,
+        "g1_sum": _g1sum,
+        "subgroup_g2": _sub_g2,
+        "subgroup_g1": _sub_g1,
+        "decompress_g2": _dec_g2,
+        "decompress_g1": _dec_g1,
+        "g1_scalar_mul": _g1_mul,
+        "g2_scalar_mul": _g2_mul,
+    }
+
+    def _bind(builder):
+        # default (CPU, 24-bit/uint64) geometry
+        return lambda: builder(limb.default_fp_ctx(), limb.default_fr_ctx())
+
+    def _bind32(builder):
+        # TPU (12-bit/uint32) geometry — the widening check's real target
+        return lambda: builder(limb.FP32, limb.FR32)
+
+    for fname, builder in heavy.items():
+        register_kernel_family(f"blsops/{fname}", _bind(builder))
+    for fname, builder in cheap.items():
+        register_kernel_family(
+            f"blsops/{fname}", _bind(builder), sentinel=True
+        )
+    # uint32-geometry sentinels: cheap ladder kernels where an implicit
+    # 64-bit promotion would silently wreck TPU throughput
+    for fname in ("subgroup_g1", "g1_scalar_mul", "decompress_g1"):
+        register_kernel_family(
+            f"blsops32/{fname}", _bind32(cheap[fname]), sentinel=True
+        )
+
+
+_register_engine_families()
 
 
 # ---------------------------------------------------------------------------

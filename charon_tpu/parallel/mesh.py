@@ -776,6 +776,137 @@ class SlotCryptoPlane:
         rand = self.make_rand(v, rng=rng)
         return self.recombine_packed(args, rand, v)
 
+    # -- analyzer registration (ISSUE 11) ---------------------------------
+
+    def kernel_families(self, prefix: str = "mesh"):
+        """This plane's program variants as named kernel families for the
+        static analyzer (charon_tpu/analysis/jaxpr_check.py): build
+        closures pack canonical generator-point inputs on the bucket
+        ladder and return (program, args) pairs that jax.make_jaxpr can
+        trace WITHOUT executing. Returns {name: blsops.KernelFamily}."""
+        import random as _random
+
+        from charon_tpu.crypto.g1g2 import G1_GEN, G2_GEN, g2_to_bytes
+
+        t = self.t
+        n = self.bucket_lanes(4)
+        mult = self.shard_count()
+        idx_row = list(range(1, t + 1))
+        rng = _random.Random(0)  # shape-only tracing — values never run
+
+        def spec(fn, args):
+            return blsops.TraceSpec(fn, args, self.ctx, n, mult)
+
+        def _points():
+            return (
+                [[G1_GEN] * t] * n,
+                [G2_GEN] * n,
+                [[G2_GEN] * t] * n,
+                [G1_GEN] * n,
+                [idx_row] * n,
+            )
+
+        def _step():
+            return spec(self._step, self.pack_inputs(*_points()))
+
+        def _step_rlc():
+            return spec(
+                self._step_rlc,
+                (*self.pack_inputs(*_points()), self.make_rand(n, rng=rng)),
+            )
+
+        def _verify():
+            args = self.pack_verify_inputs(
+                [G1_GEN] * n, [G2_GEN] * n, [G2_GEN] * n
+            )
+            return spec(self._verify, args)
+
+        def _verify_rlc():
+            args = self.pack_verify_inputs(
+                [G1_GEN] * n, [G2_GEN] * n, [G2_GEN] * n
+            )
+            return spec(
+                self._verify_rlc, (*args, self.make_lane_rand(n, rng=rng))
+            )
+
+        def _parsed():
+            return DEC.parse_g2_lane(g2_to_bytes(G2_GEN))
+
+        def _verify_dec():
+            args = self.pack_verify_inputs_parsed(
+                [G1_GEN] * n, [G2_GEN] * n, [_parsed()] * n
+            )
+            return spec(self._verify_dec, args)
+
+        def _verify_rlc_dec():
+            args = self.pack_verify_inputs_parsed(
+                [G1_GEN] * n, [G2_GEN] * n, [_parsed()] * n
+            )
+            return spec(
+                self._verify_rlc_dec,
+                (*args, self.make_lane_rand(n, rng=rng)),
+            )
+
+        def _parsed_points():
+            return (
+                [[G1_GEN] * t] * n,
+                [G2_GEN] * n,
+                [[_parsed()] * t] * n,
+                [G1_GEN] * n,
+                [idx_row] * n,
+            )
+
+        def _step_dec():
+            return spec(self._step_dec, self.pack_inputs_parsed(*_parsed_points()))
+
+        def _step_rlc_dec():
+            return spec(
+                self._step_rlc_dec,
+                (
+                    *self.pack_inputs_parsed(*_parsed_points()),
+                    self.make_rand(n, rng=rng),
+                ),
+            )
+
+        def _h2c():
+            lanes = [
+                SSWU.hash_to_field_lane(b"jaxpr-check", SSWU.DST_POP)
+            ] * n
+            live = jnp.asarray(np.ones(n, bool))
+            return spec(self._h2c, (*SSWU.pack_hashed(self.ctx, lanes), live))
+
+        def _g1dec():
+            from charon_tpu.crypto.g1g2 import g1_to_bytes
+
+            parsed = [DEC.parse_g1_lane(g1_to_bytes(G1_GEN))] * n
+            live = jnp.asarray(np.ones(n, bool))
+            return spec(
+                self._g1dec, (*DEC.pack_parsed_g1(self.ctx, parsed), live)
+            )
+
+        builders = {
+            "step": (_step, False),
+            "step_rlc": (_step_rlc, False),
+            "verify": (_verify, False),
+            "verify_rlc": (_verify_rlc, False),
+            "verify_dec": (_verify_dec, False),
+            "verify_rlc_dec": (_verify_rlc_dec, False),
+            "step_dec": (_step_dec, False),
+            "step_rlc_dec": (_step_rlc_dec, False),
+            # the warm-up programs are lighter than the pairing bodies
+            # but still SSWU/sqrt chains — h2c stays digest-covered,
+            # g1dec is cheap enough to sentinel every run
+            "h2c": (_h2c, False),
+            "g1dec": (_g1dec, True),
+        }
+        return {
+            f"{prefix}/{fname}": blsops.KernelFamily(
+                f"{prefix}/{fname}", build, sentinel
+            )
+            for fname, (build, sentinel) in builders.items()
+        }
+
+
     # canonical duty shapes: lane 1 catches the SMALLEST bucket (a lone
     # first-slot submission pads to the shard count, not to 16), the
     # rest cover the burst sizes; duplicates after bucket-padding are
@@ -872,3 +1003,22 @@ class SlotCryptoPlane:
                 report.append(("recombine-dec", self.bucket_lanes(v),
                                _time.monotonic() - t0))
         return report
+
+
+_ANALYSIS_PLANE_T = 3  # canonical threshold for the analyzer's plane
+
+
+def register_analysis_families(
+    mesh: Mesh | None = None, t: int = _ANALYSIS_PLANE_T
+) -> "SlotCryptoPlane":
+    """Build the canonical analysis plane (single-device by default —
+    the program structure is shard-count-invariant; shard_map only
+    changes the split) and register its program variants into the
+    blsops kernel-family registry. Idempotent. Called by
+    analysis/jaxpr_check.py and core/cryptoplane.kernel_inventory()."""
+    mesh = mesh or make_mesh(jax.devices()[:1])
+    plane = SlotCryptoPlane(mesh, t)
+    for name, fam in plane.kernel_families().items():
+        if name not in blsops.kernel_families():
+            blsops.register_kernel_family(name, fam.build, fam.sentinel)
+    return plane

@@ -53,23 +53,48 @@ case "$TIER" in
     python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
+    # device-graph gate (ISSUE 11): jaxpr invariants + kernel golden
+    # manifest (sentinel families traced live, the rest digest-covered)
+    python -m charon_tpu.analysis.jaxpr_check
     exec python obs_check.py --fast
     ;;
   analysis)
-    # Wall-clock budget: seconds. Machine-checked project invariants
-    # (ISSUE 10): the AST linter (monotonic-clock, typed-errors,
-    # jax-free-host, event-loop-blocking, no-swallowed-cancellation —
-    # `# lint: allow(<rule>)` pragmas mark the audited exceptions), the
+    # Wall-clock budget: ~60 s. Machine-checked project invariants
+    # (ISSUE 10 + 11): the AST linter (monotonic-clock, typed-errors,
+    # jax-free-host, event-loop-blocking, no-swallowed-cancellation,
+    # secret-flow — `# lint: allow(<rule>)` pragmas mark the audited
+    # exceptions; `--pragmas` prints the reviewable pragma ledger), the
     # append-only binary wire-schema contract against
     # tests/testdata/wire_schema.json (regenerate DELIBERATELY with
-    # `python -m charon_tpu.analysis.schema_check --update`), and the
-    # app/metrics.py <-> docs/metrics.md catalogue sync. Everything
-    # here is jax-free and runs on any host. The analysis test battery
-    # (rule fixtures, sanitizer deadlock/leak scenarios, checker teeth)
-    # rides the normal fast tier in tests/test_analysis_*.py.
+    # `python -m charon_tpu.analysis.schema_check --update`), the
+    # app/metrics.py <-> docs/metrics.md catalogue sync, and the
+    # device-graph analyzer (ISSUE 11): every registered kernel family
+    # — blsops engine kernels, mesh program variants, the sswu/
+    # decompress graphs they wrap — checked for host callbacks, float
+    # promotions, limb-dtype widening, and off-bucket-ladder shapes,
+    # with primitive censuses gated against
+    # tests/testdata/kernel_manifest.json (re-bless DELIBERATE kernel
+    # changes with `python -m charon_tpu.analysis.jaxpr_check
+    # --update`). The jaxpr gate traces (never executes) under
+    # JAX_PLATFORMS=cpu: cheap sentinel families live every run, the
+    # 25-60 s/trace pairing families via the manifest's source digest
+    # (a digest mismatch = kernel sources actually changed = full
+    # retrace). Everything else is jax-free. The analysis test battery
+    # (rule fixtures, sanitizer deadlock/leak scenarios, checker teeth,
+    # seeded jaxpr violations) rides the fast tier in
+    # tests/test_analysis_*.py.
     python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py
     python -m charon_tpu.analysis.schema_check
-    exec python -m charon_tpu.analysis.metrics_check
+    python -m charon_tpu.analysis.metrics_check
+    # the jaxpr gate is the one analysis checker that NEEDS jax (it
+    # traces the device graphs); on jax-less images skip it LOUDLY —
+    # the jax-free gates above still ran
+    if python -c 'import jax' 2>/dev/null; then
+      exec python -m charon_tpu.analysis.jaxpr_check
+    else
+      echo "WARNING: jax not importable — skipping jaxpr device-graph gate" >&2
+      exit 0
+    fi
     ;;
   hostplane)
     # Wall-clock budget: ~60 s. Tiny shapes, CPU, no jax: asserts the
@@ -103,6 +128,9 @@ case "$TIER" in
     python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
+    # full tier retraces EVERY kernel family against the golden
+    # manifest (25-60 s per pairing family — run when touching ops/)
+    python -m charon_tpu.analysis.jaxpr_check --full
     exec python obs_check.py
     ;;
   obs)

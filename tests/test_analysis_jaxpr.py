@@ -1,0 +1,307 @@
+"""Device-graph analyzer batteries (ISSUE 11).
+
+Seeded-violation half: tiny synthetic kernel families drive each of the
+four invariant checks (host callback, float promotion, off-ladder
+shape, limb-dtype widening) plus manifest drift — every check must fire
+WITH THE KERNEL FAMILY NAMED, because the CI failure message is the
+only artifact a reviewer sees. Acceptance half: the live registry
+matches the committed kernel_manifest.json golden (names + source
+digest + sentinel censuses), i.e. the real tree is clean.
+
+Synthetic fixtures trace in milliseconds; the real pairing families
+trace in 25-60 s each and are exercised by the slow-marked full
+sentinel sweep at the bottom.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from charon_tpu.analysis import jaxpr_check as JC  # noqa: E402
+from charon_tpu.ops import blsops, limb  # noqa: E402
+
+
+def fam(name, fn, args, ctx=None, lanes=4, multiple=1, sentinel=True):
+    ctx = ctx or limb.default_fp_ctx()
+    build = lambda: blsops.TraceSpec(fn, args, ctx, lanes, multiple)
+    return blsops.KernelFamily(name, build, sentinel)
+
+
+def analyze(name, *a, **kw):
+    return JC.analyze_family(name, fam(name, *a, **kw))
+
+
+U64 = lambda n=4: jnp.ones((n, 16), jnp.uint64)
+U32 = lambda n=4: jnp.ones((n, 32), jnp.uint32)
+
+
+# -- seeded violations -------------------------------------------------------
+
+
+def test_clean_integer_kernel_passes_all_checks():
+    cens, violations = analyze("fake/clean", lambda x: x + x, (U64(),))
+    assert violations == []
+    assert cens["prims"].get("add", 0) >= 1
+    assert cens["lanes"] == 4 and cens["dtype"] == "uint64"
+
+
+def test_host_callback_fires_with_family_named():
+    def bad(x):
+        jax.debug.print("leak {}", x.sum())
+        return x
+
+    _, violations = analyze("fake/cbk", bad, (U64(),))
+    assert any("fake/cbk" in v and "host callback" in v for v in violations)
+
+    def worse(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct((4, 16), np.uint64), x
+        )
+
+    _, violations = analyze("fake/pcb", worse, (U64(),))
+    assert any(
+        "fake/pcb" in v and "pure_callback" in v for v in violations
+    )
+
+
+def test_float_promotion_fires():
+    def bad(x):
+        return (x.astype(jnp.float32) * 2.0).astype(jnp.uint64)
+
+    _, violations = analyze("fake/float", bad, (U64(),))
+    assert any(
+        "fake/float" in v and "float" in v and "correctness" in v
+        for v in violations
+    )
+
+
+def test_off_ladder_shape_fires():
+    _, violations = analyze(
+        "fake/ladder", lambda x: x + x, (U64(5),), lanes=5
+    )
+    assert any(
+        "fake/ladder" in v and "off the bucket ladder" in v
+        for v in violations
+    )
+    # declared lanes on the ladder but an input registered off it
+    _, violations = analyze(
+        "fake/mismatch", lambda x: x + x, (U64(8),), lanes=4
+    )
+    assert any(
+        "fake/mismatch" in v and "batch dim 8" in v for v in violations
+    )
+
+
+def test_limb_widening_fires_on_uint32_geometry_only():
+    def widen(x):
+        return x.astype(jnp.uint64) + jnp.uint64(1)
+
+    # uint32 geometry: widening past the declared limb dtype
+    _, violations = analyze(
+        "fake/widen32", widen, (U32(),), ctx=limb.FP32
+    )
+    assert any(
+        "fake/widen32" in v and "uint32->uint64" in v for v in violations
+    )
+    # index conversions (int32 -> int64) are exempt — only limb data
+    def index_convert(x):
+        idx = jnp.arange(4, dtype=jnp.int32).astype(jnp.int64)
+        return x[idx]
+
+    _, violations = analyze(
+        "fake/idx", index_convert, (U32(),), ctx=limb.FP32
+    )
+    assert not any("widens limb data" in v for v in violations)
+
+
+def test_manifest_drift_yields_named_per_primitive_diff():
+    cens, _ = analyze("fake/drift", lambda x: x + x * x, (U64(),))
+    golden = json.loads(json.dumps(cens))  # deep copy
+    golden["prims"]["add"] = golden["prims"].get("add", 0) + 3
+    golden["prims"]["gather"] = 7  # a primitive that vanished
+    diffs = JC.diff_census("fake/drift", golden, cens)
+    assert any("prim add" in d and "-3" in d for d in diffs)
+    assert any("prim gather 7 -> 0" in d for d in diffs)
+    assert all(d.startswith("fake/drift:") for d in diffs)
+
+
+def test_eqn_count_and_aval_drift_detected():
+    cens, _ = analyze("fake/avals", lambda x: x + x, (U64(),))
+    golden = json.loads(json.dumps(cens))
+    golden["eqns"] += 1
+    golden["in_avals"] = ["uint64[8,16]"]
+    diffs = JC.diff_census("fake/avals", golden, cens)
+    assert any("eqns" in d for d in diffs)
+    assert any("in_avals" in d for d in diffs)
+
+
+# -- run_check flow ----------------------------------------------------------
+
+
+def _manifest_for(families, digest="d0"):
+    out = {}
+    for name, f in families.items():
+        cens, _ = JC.analyze_family(name, f)
+        out[name] = cens
+    return {
+        "version": 1,
+        "jax_version": jax.__version__,
+        "source_digest": digest,
+        "families": out,
+    }
+
+
+def test_digest_fast_path_traces_only_sentinels():
+    fams = {
+        "fake/sent": fam("fake/sent", lambda x: x + x, (U64(),)),
+        "fake/heavy": fam(
+            "fake/heavy", lambda x: x * x, (U64(),), sentinel=False
+        ),
+    }
+    manifest = _manifest_for(fams)
+    failures, traced, n = JC.run_check(
+        fams, manifest, digest="d0"
+    )
+    assert failures == []
+    assert n == 1 and "fake/sent" in traced  # heavy rode the digest
+
+
+def test_digest_mismatch_forces_full_retrace():
+    fams = {
+        "fake/sent": fam("fake/sent", lambda x: x + x, (U64(),)),
+        "fake/heavy": fam(
+            "fake/heavy", lambda x: x * x, (U64(),), sentinel=False
+        ),
+    }
+    manifest = _manifest_for(fams)
+    failures, traced, n = JC.run_check(fams, manifest, digest="CHANGED")
+    assert failures == [] and n == 2  # clean, but everything re-traced
+
+
+def test_removed_and_unblessed_families_fail():
+    fams = {"fake/a": fam("fake/a", lambda x: x + x, (U64(),))}
+    manifest = _manifest_for(fams)
+    manifest["families"]["fake/gone"] = {"prims": {}, "eqns": 0}
+    fams["fake/new"] = fam("fake/new", lambda x: x * x, (U64(),))
+    failures, _, _ = JC.run_check(fams, manifest, digest="d0")
+    assert any("fake/gone" in f and "no longer registered" in f for f in failures)
+    assert any("fake/new" in f and "missing from" in f for f in failures)
+
+
+def test_source_digest_tracks_graph_sources(tmp_path):
+    (tmp_path / "charon_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "charon_tpu" / "parallel").mkdir(parents=True)
+    src = tmp_path / "charon_tpu" / "ops" / "limb.py"
+    src.write_text("A = 1\n")
+    d1 = JC.source_digest(tmp_path)
+    src.write_text("A = 2\n")
+    d2 = JC.source_digest(tmp_path)
+    assert d1 != d2
+    src.write_text("A = 1\n")
+    assert JC.source_digest(tmp_path) == d1
+
+
+# -- acceptance: the live tree is clean against the committed golden ---------
+
+
+def test_manifest_golden_covers_live_registry():
+    manifest = JC.load_manifest()
+    assert manifest is not None, "tests/testdata/kernel_manifest.json missing"
+    fams = JC.gather_families()
+    assert set(manifest["families"]) == set(fams)
+    assert manifest["jax_version"] == jax.__version__
+    assert manifest["source_digest"] == JC.source_digest(), (
+        "kernel sources changed since the manifest was blessed — run "
+        "python -m charon_tpu.analysis.jaxpr_check --update"
+    )
+    # sentinel flags agree
+    for name, f in fams.items():
+        assert manifest["families"][name]["sentinel"] == f.sentinel
+
+
+def test_live_tree_clean_on_cheap_sentinels():
+    """Trace the two cheapest real families (one per limb geometry)
+    and hold them to the golden censuses + all four invariant checks —
+    live teeth in the fast tier without the 25-60 s pairing traces."""
+    manifest = JC.load_manifest()
+    assert manifest is not None
+    fams = JC.gather_families()
+    failures, traced, n = JC.run_check(
+        fams,
+        manifest,
+        only=["blsops/subgroup_g1", "blsops32/subgroup_g1"],
+    )
+    assert n == 2
+    assert failures == [], "\n".join(failures)
+
+
+@pytest.mark.slow
+def test_live_tree_clean_full_sentinel_sweep():
+    """Every sentinel family re-traced against the golden (the exact
+    `ci.sh analysis` gate, minus the process boundary)."""
+    manifest = JC.load_manifest()
+    assert manifest is not None
+    fams = JC.gather_families()
+    failures, traced, n = JC.run_check(
+        fams, manifest, digest=JC.source_digest()
+    )
+    assert failures == [], "\n".join(failures)
+    assert n == sum(1 for f in fams.values() if f.sentinel)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_list_inventory(capsys):
+    assert JC.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "blsops/verify" in out and "mesh/step_rlc" in out
+    assert "sentinel" in out
+
+
+def test_cli_missing_manifest_exit_1(tmp_path, capsys):
+    assert JC.main(["--manifest", str(tmp_path / "nope.json")]) == 1
+    assert "--update" in capsys.readouterr().err
+
+
+def test_cli_family_mode_against_committed_golden(capsys):
+    if JC.load_manifest() is None:
+        pytest.skip("no committed manifest")
+    assert JC.main(["--family", "blsops/subgroup_g1"]) == 0
+    err = capsys.readouterr().err
+    assert "1 traced" in err
+
+
+def test_cli_unknown_family_raises():
+    with pytest.raises(KeyError):
+        JC.run_check({}, None, only=["fake/nope"])
+
+
+# -- review-finding regressions ----------------------------------------------
+
+
+def test_update_blesses_over_removed_family():
+    # removing a family must be re-blessable: in update mode the
+    # rewritten manifest simply omits it (review finding: the removed-
+    # family failure used to fire unconditionally, so --update could
+    # never succeed after a deletion)
+    fams = {"fake/keep": fam("fake/keep", lambda x: x + x, (U64(),))}
+    manifest = _manifest_for(fams)
+    manifest["families"]["fake/gone"] = {"prims": {}, "eqns": 0}
+    failures, traced, _ = JC.run_check(
+        fams, manifest, update=True, digest="d0"
+    )
+    assert failures == []
+    assert set(traced) == {"fake/keep"}
+
+
+def test_cli_rejects_update_with_family(capsys):
+    # --update --family used to exit 0 having blessed nothing
+    assert JC.main(["--update", "--family", "blsops/subgroup_g1"]) == 2
+    assert "cannot be combined" in capsys.readouterr().err

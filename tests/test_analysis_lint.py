@@ -494,3 +494,233 @@ def test_repo_tree_lints_clean():
     violations, n = lint.lint_paths(targets)
     assert n > 100  # sanity: the walk actually saw the tree
     assert violations == [], "\n".join(v.render() for v in violations)
+
+
+# -- secret-flow (ISSUE 11) --------------------------------------------------
+
+
+from charon_tpu.analysis.rule_secret_flow import SecretFlow  # noqa: E402
+
+
+def run_sf(src: str, relpath: str = "charon_tpu/dkg/fake.py"):
+    return run(src, relpath=relpath, rules=[SecretFlow()])
+
+
+def test_secret_flow_flags_source_call_into_log():
+    vs = run_sf(
+        """
+        from charon_tpu import tbls
+        from charon_tpu.app import log
+        def f():
+            key = tbls.generate_secret_key()
+            log.info("made key", key=key)
+        """
+    )
+    assert names(vs) == ["secret-flow"]
+    assert "log call" in vs[0].message
+
+
+def test_secret_flow_flags_fstring_and_raise():
+    vs = run_sf(
+        """
+        from charon_tpu import tbls
+        def f(total, threshold):
+            secret = tbls.generate_secret_key()
+            shares = tbls.threshold_split(secret, total, threshold)
+            msg = f"split into {shares}"
+            raise ValueError("bad share set: " + "x")
+        def g(shares):
+            raise ValueError(f"bad shares {shares}")
+        """
+    )
+    # f-string in f() + (f-string, raise) pair in g()
+    kinds = [v.message for v in vs]
+    assert any("f-string" in m for m in kinds)
+    assert any("raised exception" in m for m in kinds)
+
+
+def test_secret_flow_alias_resolution_and_items_loop():
+    # taint survives aliasing and .items(); dict KEYS (share indices)
+    # stay clean so attribution messages don't false-positive
+    vs = run_sf(
+        """
+        from charon_tpu import tbls
+        def f(n, t):
+            shares = tbls.threshold_split(tbls.generate_secret_key(), n, t)
+            aliased = shares
+            copied = dict(aliased)
+            for idx, share_val in copied.items():
+                print(f"peer {idx} ok")      # index only: clean
+                print(f"share {share_val}")  # value: violation
+        """
+    )
+    assert names(vs) == ["secret-flow"]
+
+
+def test_secret_flow_taint_dies_at_one_way_calls():
+    # signing with a secret yields a PUBLIC partial signature; scalar
+    # muls yield public commitments — no violation downstream
+    vs = run_sf(
+        """
+        from charon_tpu import tbls
+        def f(root, transport):
+            secret = tbls.generate_secret_key()
+            sig = tbls.sign(secret, root)
+            print(f"partial {sig.hex()}")
+            transport.broadcast(sig)
+        """
+    )
+    assert vs == []
+
+
+def test_secret_flow_wire_metrics_span_sinks():
+    vs = run_sf(
+        """
+        def f(node, metric, span, shares):
+            node.publish("tag", shares)
+            metric.labels(shares[0]).inc()
+            span.set_attr("share", shares)
+        """
+    )
+    assert names(vs) == ["secret-flow"] * 3
+
+
+def test_secret_flow_len_is_attribution_not_material():
+    vs = run_sf(
+        """
+        def f(shares):
+            print(f"have {len(shares)} shares")
+        """
+    )
+    assert vs == []
+
+
+def test_secret_flow_dataclass_auto_repr():
+    vs = run_sf(
+        """
+        from dataclasses import dataclass, field
+        @dataclass
+        class Bad:
+            idx: int
+            shares: tuple
+        @dataclass
+        class Good:
+            idx: int
+            shares: tuple = field(repr=False)
+        """
+    )
+    assert names(vs) == ["secret-flow"]
+    assert "Bad.shares" in vs[0].message
+
+
+def test_secret_flow_class_attr_alias_resolution():
+    # self._polys assigned from the secrets module in __init__ taints
+    # self._polys loads in OTHER methods
+    vs = run_sf(
+        """
+        import secrets
+        class P:
+            def __init__(self, t):
+                self._polys = [secrets.randbelow(7) for _ in range(t)]
+            def dump(self):
+                print(f"polys {self._polys}")
+        """
+    )
+    assert names(vs) == ["secret-flow"]
+
+
+def test_secret_flow_pragma_silences_audited_sink():
+    vs = run_sf(
+        """
+        def f(node, shares):
+            # sealed channel  # lint: allow(secret-flow)
+            node.publish("tag", shares)
+        """
+    )
+    assert vs == []
+
+
+def test_secret_flow_out_of_scope_ignored():
+    vs = run_sf(
+        """
+        def f(shares):
+            print(f"{shares}")
+        """,
+    )
+    assert len(vs) == 1
+    mod = lint.LintModule(
+        "def f(shares):\n    print(f'{shares}')\n", relpath="other/x.py"
+    )
+    assert not SecretFlow().applies(mod)
+
+
+# -- pragma audit report (ISSUE 11) ------------------------------------------
+
+
+def test_pragma_audit_lists_rule_file_line(tmp_path):
+    f = tmp_path / "audited.py"
+    f.write_text(
+        "import time\n"
+        "def f():\n"
+        "    # why wall time is right  # lint: allow(monotonic-clock)\n"
+        "    return time.time()\n"
+        "def g(node, shares):\n"
+        "    node.publish('t', shares)  # lint: allow(secret-flow, monotonic-clock)\n"
+    )
+    entries = lint.audit_pragmas([str(f)])
+    rules = [(r, line) for r, _, line, _ in entries]
+    assert rules == [
+        ("monotonic-clock", 3),
+        ("monotonic-clock", 6),
+        ("secret-flow", 6),
+    ]
+    # the snippet column carries the allowed source line
+    assert "time.time" not in entries[0][3]  # pragma line itself
+    assert "publish" in entries[1][3]
+
+
+def test_pragma_audit_ignores_docstring_mentions(tmp_path):
+    f = tmp_path / "doc.py"
+    f.write_text(
+        '"""docs show `# lint: allow(fake-rule)` syntax."""\n'
+        "x = 1\n"
+    )
+    assert lint.audit_pragmas([str(f)]) == []
+
+
+def test_pragma_audit_cli(tmp_path, capsys):
+    f = tmp_path / "a.py"
+    f.write_text("y = 1  # lint: allow(typed-errors)\n")
+    assert lint.main(["--pragmas", str(f)]) == 0
+    out = capsys.readouterr()
+    assert "typed-errors" in out.out
+    assert "1 pragma(s)" in out.err
+
+
+def test_docstring_pragma_no_longer_allowlists():
+    # a docstring MENTIONING the pragma syntax on a violating line must
+    # not silence the rule (comment tokens only)
+    vs = run(
+        """
+        import time
+        def f():
+            "calls time.time()  # lint: allow(monotonic-clock)"
+            return time.time()
+        """,
+        rules=[MonotonicClock()],
+    )
+    assert names(vs) == ["monotonic-clock"]
+
+
+def test_secret_flow_attr_only_function_is_scanned():
+    # a function whose ONLY secret access is a secret-named attribute
+    # on an untainted parameter must still be checked (review finding:
+    # the old tainted-locals early-out skipped these)
+    vs = run_sf(
+        """
+        from charon_tpu.app import log
+        def report(res):
+            log.error(f"dkg failed for {res.secret_share}")
+        """
+    )
+    assert names(vs) == ["secret-flow"]

@@ -85,3 +85,41 @@ def test_frost_rejects_bad_share():
             parts[1].round2(all_bcasts, shares_to_1)
 
     asyncio.run(run())
+
+
+# -- secret-flow regression: sanitized reprs (ISSUE 11) ----------------------
+
+
+def test_round1_shares_repr_never_shows_share_scalars():
+    """Round1Shares travels the exchange layer; any log line, codec
+    error, or 'Task exception was never retrieved' traceback that
+    formats one must not dump the raw Shamir shares (secret-flow lint
+    finding, fixed with field(repr=False))."""
+    sh = frost.Round1Shares(shares=(0xDEADBEEFCAFE, 0x1234567890AB))
+    for rendered in (repr(sh), str(sh), f"{sh}"):
+        assert "deadbeefcafe" not in rendered.lower()
+        assert "3735928559" not in rendered  # decimal spelling
+        assert str(0xDEADBEEFCAFE) not in rendered
+    assert rendered.startswith("Round1Shares(")  # still identifies itself
+
+
+def test_frost_result_repr_hides_secret_share_keeps_public_half():
+    results = run_ceremony(n=4, t=3, v=1)
+    r = results[0][0]
+    rendered = repr(r)
+    assert str(r.secret_share) not in rendered
+    assert hex(r.secret_share)[2:] not in rendered.lower()
+    # the public halves stay formatted for debuggability
+    assert "group_pubkey" in rendered and "pubshares" in rendered
+
+
+def test_dkg_result_repr_hides_share_secrets():
+    pytest.importorskip("cryptography")  # ceremony imports k1util
+    from charon_tpu.dkg.ceremony import DKGResult
+
+    secret = b"\x42" * 32
+    res = DKGResult(lock=None, share_secrets=[secret])
+    rendered = repr(res)
+    assert "42424242" not in rendered
+    assert repr(secret) not in rendered
+    assert "lock=" in rendered
