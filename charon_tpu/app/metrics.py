@@ -291,6 +291,20 @@ class ClusterMetrics:
             labels + ["peer_index"],
             registry=self.registry,
         )
+        # Byzantine evidence (ISSUE 16): every attributed detection made
+        # by the protocol components — qbft equivocation/forged
+        # justifications/replay/floods, conflicting or spoofed partial
+        # signatures. Attribution is authenticated before recording, so
+        # the counter names ONLY the adversary (the PR 8 acceptance
+        # style); it feeds the per-peer quarantine primitive.
+        self.byzantine_evidence = Counter(
+            "byzantine_evidence_total",
+            "Attributable Byzantine-behaviour detections by offending "
+            "peer share index and evidence kind "
+            "(core/evidence.py kind catalogue)",
+            labels + ["peer", "kind"],
+            registry=self.registry,
+        )
         # multi-tenant crypto-plane service (ISSUE 8): per-tenant flush
         # attribution, admission-shed counts, queue occupancy, breaker
         # state machine and quarantined flushes — the isolation
@@ -451,6 +465,16 @@ class ClusterMetrics:
                 )
                 if f.get("quarantined"):
                     self.labels(self.plane_tenant_quarantined, tenant).inc()
+
+        return hook
+
+    def byzantine_hook(self):
+        """core/evidence.EvidenceRegistry hook: one increment per
+        attributed Byzantine detection, labelled by the offending peer
+        (share index) and evidence kind."""
+
+        def hook(peer, kind: str) -> None:
+            self.labels(self.byzantine_evidence, str(peer), kind).inc()
 
         return hook
 

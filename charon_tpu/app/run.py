@@ -561,8 +561,15 @@ async def build_node(config: Config) -> Node:
         parsig_transport = MemTransport()
 
     # -- core workflow ----------------------------------------------------
+    # Byzantine-evidence ledger (ISSUE 16): every attributed detection
+    # across qbft / parsigex / parsigdb increments
+    # byzantine_evidence_total{peer,kind}, and equivocation-class
+    # evidence excludes the peer's lanes from sigagg recombination.
+    from charon_tpu.core.evidence import EvidenceRegistry
+
+    evidence = EvidenceRegistry(hook=metrics.byzantine_hook())
     dutydb = DutyDB()
-    parsigdb = ParSigDB(threshold=t)
+    parsigdb = ParSigDB(threshold=t, evidence=evidence)
     sigagg = SigAgg(
         threshold=t,
         fork=fork,
@@ -570,6 +577,7 @@ async def build_node(config: Config) -> Node:
         plane=tenant_plane,
         pubshares_by_idx=pubshares_by_idx if tenant_plane else None,
         clock=clock if tenant_plane else None,
+        evidence=evidence,
     )
     # impl selected by the AGG_SIG_DB_V2 feature flag (ref: app wiring
     # gates memory_v2 behind the alpha flag)
@@ -588,7 +596,12 @@ async def build_node(config: Config) -> Node:
     ]
     duty_gater = DutyGater(clock, slots_per_epoch=config.slots_per_epoch)
     qbft_consensus = QBFTConsensus(
-        qbft_net, n, privkey=k1_key, pubkeys=op_pubkeys, gater=duty_gater
+        qbft_net,
+        n,
+        privkey=k1_key,
+        pubkeys=op_pubkeys,
+        gater=duty_gater,
+        evidence=evidence,
     )
     consensus = ConsensusController(qbft_consensus)
 
@@ -617,7 +630,11 @@ async def build_node(config: Config) -> Node:
         clock=clock if tenant_plane else None,
     )
     parsigex = ParSigEx(
-        share_idx, parsig_transport, verifier, gater=duty_gater
+        share_idx,
+        parsig_transport,
+        verifier,
+        gater=duty_gater,
+        evidence=evidence,
     )
     scheduler = Scheduler(
         beacon,

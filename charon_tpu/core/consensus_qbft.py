@@ -56,7 +56,7 @@ class MemMsgNet:
         for node in self.nodes:
             if node.node_idx != from_idx:
                 with detached():
-                    node.deliver(duty, msg, values, tctx=tctx)
+                    node.deliver(duty, msg, values, tctx=tctx, sender=from_idx)
 
 
 class QBFTConsensus:
@@ -74,6 +74,7 @@ class QBFTConsensus:
         timer: str | None = None,
         linear_round_inc: float = qbft.LINEAR_ROUND_INC,
         tracer=None,  # app/tracer.Tracer; None = process-global
+        evidence=None,  # core/evidence.EvidenceRegistry; None = unrecorded
     ) -> None:
         """`privkey`/`pubkeys` enable per-message k1 authentication
         (ref: core/consensus/qbft/transport.go:25-50 signs every msg,
@@ -95,6 +96,10 @@ class QBFTConsensus:
         self.tracer = tracer
         self._privkey = privkey
         self._pubkeys = pubkeys
+        # Byzantine-evidence ledger (core/evidence.EvidenceRegistry):
+        # engine detections land here attributed by SHARE index (the
+        # cluster-wide peer convention: share = engine node idx + 1).
+        self.evidence = evidence
         # Duty gater: without it, deliver() would create transports and
         # value caches for ANY duty a byzantine-but-authenticated peer
         # names — unbounded memory (ref: consensus also gates inbound
@@ -124,6 +129,17 @@ class QBFTConsensus:
                 return True
             return self._verify_msg(m, check_justification=True)
 
+        def verify_sender(m: qbft.Msg) -> bool:
+            # outer signature only — the engine uses this to attribute
+            # evidence (forged justifications, floods) to the sender
+            if pubkeys is None:
+                return True
+            return self._verify_msg(m, check_justification=False)
+
+        def on_evidence(source: int, kind: str) -> None:
+            if self.evidence is not None:
+                self.evidence.record(source + 1, kind)
+
         if timer is None:
             from charon_tpu.app import featureset
 
@@ -152,6 +168,8 @@ class QBFTConsensus:
             new_timer=new_timer,
             is_valid=is_valid,
             sign_msg=sign_msg,
+            verify_sender=verify_sender,
+            on_evidence=on_evidence,
         )
         self._subs: list[DecidedSub] = []
         # Consensus sniffer: bounded ring of recent message summaries
@@ -220,7 +238,12 @@ class QBFTConsensus:
         return tr
 
     def deliver(
-        self, duty: Duty, msg: qbft.Msg, values, tctx: str | None = None
+        self,
+        duty: Duty,
+        msg: qbft.Msg,
+        values,
+        tctx: str | None = None,
+        sender: int | None = None,
     ) -> None:
         """Incoming message from the fabric; values-by-hash cache merge.
 
@@ -229,12 +252,28 @@ class QBFTConsensus:
         peer cannot bind a decided hash to substituted duty data
         (ref: core/consensus/qbft/qbft.go valuesByHash recomputes).
 
+        `sender` is the CHANNEL identity (the authenticated node index
+        the frame arrived from), distinct from msg.source (the signer's
+        claim). Nodes only broadcast their own top-level messages, so a
+        frame whose source differs from its channel — or whose instance
+        differs from the duty it was delivered under — is a replay or
+        spoof by the CHANNEL peer: the one attribution the engine itself
+        cannot make, because a replayed message carries the original
+        (possibly honest) signer's source. Dropped before any engine or
+        cache state is touched, evidence named to the channel.
+
         `tctx` is the sending node's propagated trace context: the
         message-handling span joins the sender's duty trace, which is
         how a follower's consensus work appears in the proposer's
         cross-node timeline. Malformed tctx decodes to None (fresh
         duty-rooted span) — frame corruption never crashes delivery."""
         if self._gater is not None and not self._gater(duty):
+            return
+        if sender is not None and (
+            msg.source != sender or msg.instance != duty
+        ):
+            if self.evidence is not None:
+                self.evidence.record(sender + 1, "qbft_replay")
             return
         from charon_tpu.app.tracer import parse_ctx, span
 

@@ -22,7 +22,14 @@ from charon_tpu.core.types import Duty, PubKey
 
 class SigConflictError(Exception):
     """Same share index submitted two different signatures for one duty —
-    byzantine behaviour worth surfacing (ref: memory.go conflict errors)."""
+    byzantine behaviour worth surfacing (ref: memory.go conflict errors).
+
+    Since ISSUE 16 the store no longer raises this: a raise mid-batch
+    aborted the remaining (honest) pubkeys of the same store call, so one
+    double-signed lane could take down a whole peer set. Conflicts are now
+    recorded as evidence (first signature wins, `conflicts` counter +
+    EvidenceRegistry), and the class stays importable for callers that
+    still reference it."""
 
 
 InternalSub = Callable[[Duty, dict[PubKey, ParSignedData]], Awaitable[None]]
@@ -32,11 +39,28 @@ ThresholdSub = Callable[
 
 
 class ParSigDB:
-    def __init__(self, threshold: int) -> None:
+    def __init__(
+        self,
+        threshold: int,
+        evidence=None,  # core/evidence.EvidenceRegistry; None = unrecorded
+        max_pending_per_peer: int = 512,
+    ) -> None:
         self.threshold = threshold
+        self.evidence = evidence
+        # Cap on distinct un-emitted (duty, pubkey) keys ONE share index
+        # may hold partials for: without it a byzantine peer streaming
+        # valid-format partials for fabricated keys grows the store
+        # without limit between trims. Honest peers hold at most
+        # (live duties x validators) pending keys at once.
+        self.max_pending_per_peer = max_pending_per_peer
+        self.conflicts = 0  # double-signed lanes (first wins)
+        self.flood_dropped = 0  # partials refused at the pending cap
         # (duty, pubkey) -> share_idx -> ParSignedData
         self._store: dict[tuple[Duty, PubKey], dict[int, ParSignedData]] = (
             defaultdict(dict)
+        )
+        self._pending_per_peer: dict[int, set[tuple[Duty, PubKey]]] = (
+            defaultdict(set)
         )
         self._emitted: set[tuple[Duty, PubKey]] = set()
         self._internal_subs: list[InternalSub] = []
@@ -111,10 +135,28 @@ class ParSigDB:
         prev = sigs.get(psig.share_idx)
         if prev is not None:
             if prev.data.signature != psig.data.signature:
-                raise SigConflictError(
-                    f"share {psig.share_idx} equivocated for {duty}/{pubkey}"
-                )
-            return None  # duplicate
+                # Byzantine double-sign: the share equivocated for this
+                # duty/validator. First signature wins; record evidence
+                # and CONTINUE — raising here would let one adversarial
+                # lane abort the remaining honest pubkeys of the batch.
+                self.conflicts += 1
+                if self.evidence is not None:
+                    self.evidence.record(
+                        psig.share_idx,
+                        "parsig_conflict",
+                        detail=f"{duty}/{pubkey}",
+                    )
+            return None  # duplicate or conflicting (first wins)
+        pending = self._pending_per_peer[psig.share_idx]
+        if key not in self._emitted and key not in pending:
+            if len(pending) >= self.max_pending_per_peer:
+                self.flood_dropped += 1
+                if self.evidence is not None:
+                    self.evidence.record(
+                        psig.share_idx, "parsig_flood"
+                    )
+                return None
+            pending.add(key)
         sigs[psig.share_idx] = psig
 
         if key in self._emitted:
@@ -127,6 +169,10 @@ class ParSigDB:
         batch = by_root.get(psig.message_root())
         if batch is not None and len(batch) == self.threshold:
             self._emitted.add(key)
+            # emitted keys stop counting against every contributor's
+            # pending budget
+            for peer_pending in self._pending_per_peer.values():
+                peer_pending.discard(key)
             return sorted(batch, key=lambda s: s.share_idx)
         return None
 
@@ -138,3 +184,6 @@ class ParSigDB:
             {k: v for k, v in self._store.items() if k[0] != expired},
         )
         self._emitted = {k for k in self._emitted if k[0] != expired}
+        for pending in self._pending_per_peer.values():
+            for key in [k for k in pending if k[0] == expired]:
+                pending.discard(key)

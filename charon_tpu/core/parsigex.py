@@ -174,7 +174,9 @@ class MemTransport:
                 continue
             try:
                 with detached():
-                    await node.receive(duty, signed_set, tctx=tctx)
+                    await node.receive(
+                        duty, signed_set, tctx=tctx, sender=from_idx
+                    )
             except Exception as e:  # noqa: BLE001 — per-peer isolation
                 from charon_tpu.app import log
 
@@ -201,6 +203,7 @@ class ParSigEx:
         gater: Callable[[Duty], bool] | None = None,
         clock: SlotClock | None = None,
         tracer=None,  # app/tracer.Tracer; None = process-global
+        evidence=None,  # core/evidence.EvidenceRegistry; None = unrecorded
     ) -> None:
         self.share_idx = share_idx
         self.transport = transport
@@ -208,7 +211,10 @@ class ParSigEx:
         self.gater = gater
         self.clock = clock
         self.tracer = tracer
+        self.evidence = evidence
         self.dropped_stale = 0  # metric: sets gated before crypto
+        self.dropped_spoofed = 0  # sets claiming another peer's share idx
+        self.dropped_invalid = 0  # sets that failed signature verification
         self.resend_total = 0  # metric: deadline-retry resends
         self._subs: list[ExSub] = []
         self._retry_tasks: set = set()
@@ -298,10 +304,20 @@ class ParSigEx:
         duty: Duty,
         signed_set: dict[PubKey, ParSignedData],
         tctx: str | None = None,
+        sender: int | None = None,
     ) -> None:
         """Peer partials arrive; gate, verify, then store
         (ref: parsigex.go:68-109). The gater runs *before* signature
         verification so stale floods never reach the batch verifier.
+
+        `sender` is the CHANNEL identity — the authenticated share index
+        the transport received this frame from (None for direct callers
+        and legacy fakes). With it, two Byzantine detections attribute to
+        the right peer: a set claiming a DIFFERENT share index than its
+        channel is a spoof by the channel peer (dropped before any
+        crypto — otherwise forged partials stamped with a victim's index
+        would bill evidence to the victim), and a set that fails
+        verification is billed to the channel that delivered it.
 
         `tctx` is the sender's propagated trace context: the receive
         span (and everything nested under it — verification, the
@@ -313,6 +329,13 @@ class ParSigEx:
 
         if self.gater is not None and not self.gater(duty):
             self.dropped_stale += 1
+            return
+        if sender is not None and any(
+            ps.share_idx != sender for ps in signed_set.values()
+        ):
+            self.dropped_spoofed += 1
+            if self.evidence is not None:
+                self.evidence.record(sender, "parsig_spoof")
             return
         with span(
             "parsigex.receive",
@@ -331,6 +354,21 @@ class ParSigEx:
                     # less rung above
                     ok = self.verifier.verify(duty, signed_set)  # lint: allow(event-loop-blocking)
                 if not ok:
-                    return  # drop invalid sets (logged/tracked in the full stack)
+                    # drop invalid sets; billed to the channel peer when
+                    # known, else to the claimed share indices (the best
+                    # identity a channel-less caller has)
+                    self.dropped_invalid += 1
+                    if self.evidence is not None:
+                        peers = (
+                            {sender}
+                            if sender is not None
+                            else {
+                                ps.share_idx
+                                for ps in signed_set.values()
+                            }
+                        )
+                        for peer in peers:
+                            self.evidence.record(peer, "parsig_invalid")
+                    return
             for sub in self._subs:
                 await sub(duty, signed_set)

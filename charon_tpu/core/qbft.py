@@ -167,6 +167,23 @@ class Definition:
     is_valid: Callable[[Msg], bool] = lambda m: True
     # Applied to every outbound message before broadcast/loopback.
     sign_msg: Callable[[Msg], Msg] = lambda m: m
+    # Outer-signature-only check (no justification recursion). Used to
+    # attribute evidence safely: a message whose SENDER authenticates but
+    # whose piggybacked justification does not was forged by that sender,
+    # while a message failing the outer check proves nothing about the
+    # claimed source. None = fall back to is_valid (the unsigned/
+    # channel-authenticated fabrics, where is_valid is trivially cheap).
+    verify_sender: Callable[[Msg], bool] | None = None
+    # Per-sender cap on messages the engine STORES for this instance (the
+    # Transport bound only covers outstanding inbox depth — a sustained
+    # flood streams through it into `_Engine.msgs` otherwise). A
+    # protocol-honest sender stores <= 4 messages per round, so the
+    # default allows ~32 rounds of headroom.
+    max_stored_per_source: int = 128
+    # Byzantine-evidence sink: (source, kind) per attributed detection.
+    # Kind literals match core/evidence.py constants; the engine stays
+    # import-free by design.
+    on_evidence: Callable[[int, str], None] | None = None
 
     @property
     def quorum(self) -> int:
@@ -177,13 +194,20 @@ class Definition:
         return (self.nodes - 1) // 3
 
 
+class DropReason(enum.Enum):
+    """Why a transport refused an inbound message (typed, countable)."""
+
+    SOURCE_OVER_BOUND = "source_over_bound"
+
+
 class Transport:
     """Broadcast + inbound queue. The engine owns no sockets.
 
     The inbox is bounded per source (ref: core/qbft bounds the per-peer
     FIFO) so one byzantine peer cannot grow memory without limit: messages
     beyond `max_buffered_per_source` outstanding from one source are
-    dropped at receive time."""
+    dropped at receive time, with the drop typed and counted in `drops`
+    so callers (and the Byzantine harness) can assert the bound fired."""
 
     def __init__(
         self,
@@ -194,11 +218,15 @@ class Transport:
         self.inbox: asyncio.Queue[Msg] = asyncio.Queue()
         self.max_buffered_per_source = max_buffered_per_source
         self._buffered: dict[int, int] = {}
+        # (source, DropReason) -> count of refused messages
+        self.drops: dict[tuple[int, DropReason], int] = {}
 
     def receive(self, msg: Msg) -> bool:
         """Enqueue an inbound message; False = dropped (source over bound)."""
         n = self._buffered.get(msg.source, 0)
         if n >= self.max_buffered_per_source:
+            key = (msg.source, DropReason.SOURCE_OVER_BOUND)
+            self.drops[key] = self.drops.get(key, 0) + 1
             return False
         self._buffered[msg.source] = n + 1
         self.inbox.put_nowait(msg)
@@ -232,6 +260,7 @@ async def run(
     result = await engine.run(value, value_ch)
     if stats is not None:
         stats["round"] = engine.round
+        stats["drops"] = engine.drop_stats()
     return result
 
 
@@ -248,6 +277,18 @@ class _Engine:
         self.input_value = None
         # dedup: (type, source, round) -> Msg (first wins per slot)
         self.msgs: dict[tuple[MsgType, int, int], Msg] = {}
+        # stored-message count per source (bounded by
+        # Definition.max_stored_per_source — see _accept)
+        self._stored_per_source: dict[int, int] = {}
+        # flood evidence is attributed at most once per source per
+        # instance (attribution costs one outer signature verify; the
+        # drop itself stays free)
+        self._flood_flagged: set[int] = set()
+        # typed drop counters (satellite: dropped AND counted)
+        self.replay_dropped = 0  # foreign-instance messages
+        self.dup_dropped = 0  # identical re-deliveries
+        self.flood_dropped = 0  # per-source stored bound hit
+        self.equivocation_dropped = 0  # conflicting msg in a filled slot
         self.sent_prepare: set[int] = set()
         self.sent_commit: set[int] = set()
         self.sent_preprepare: set[int] = set()
@@ -283,8 +324,34 @@ class _Engine:
         if self._accept(msg):
             await self._on_msg(msg)
 
+    def drop_stats(self) -> dict[str, int]:
+        """Typed drop counters (surfaced via qbft.run stats)."""
+        return {
+            "replay": self.replay_dropped,
+            "duplicate": self.dup_dropped,
+            "flood": self.flood_dropped,
+            "equivocation": self.equivocation_dropped,
+        }
+
+    def _evidence(self, source: int, kind: str) -> None:
+        if self.d.on_evidence is not None:
+            self.d.on_evidence(source, kind)
+
+    def _sender_authentic(self, msg: Msg) -> bool:
+        """May evidence be attributed to msg.source? Outer signature only
+        — without this check, garbage stamped with a victim's source
+        index would let an adversary frame an honest peer."""
+        if self.d.verify_sender is not None:
+            return self.d.verify_sender(msg)
+        return self.d.is_valid(msg)
+
     def _accept(self, msg: Msg) -> bool:
         if msg.instance != self.instance:
+            # Cross-instance replay: counted but NOT attributed here —
+            # msg.source names the original (possibly honest) signer,
+            # not whoever replayed the frame. Channel-level attribution
+            # lives in the adapter (consensus_qbft.deliver sender check).
+            self.replay_dropped += 1
             return False
         if not (0 <= msg.source < self.d.nodes):
             return False
@@ -292,25 +359,72 @@ class _Engine:
         # message must not cost ECDSA verifies (a justification-laden msg
         # carries ~2*quorum signatures — free CPU amplification otherwise).
         key = (msg.type, msg.source, msg.round)
-        if key in self.msgs:
+        stored = self.msgs.get(key)
+        if stored is not None:
+            if msg == stored or msg_digest(msg) == msg_digest(stored):
+                self.dup_dropped += 1  # identical content: plain replay
+            elif len(msg.justification) <= 2 * self.d.nodes and (
+                self.d.is_valid(msg)
+            ):
+                # Two DIFFERENT validly-signed messages in one
+                # (type, source, round) slot: equivocation. First wins;
+                # the full is_valid (not just the outer check) runs first
+                # so unverifiable garbage cannot frame the slot's owner —
+                # one verify per colliding frame, no cheaper for the
+                # attacker than sending a fresh message.
+                self.equivocation_dropped += 1
+                self._evidence(msg.source, "qbft_equivocation")
+            return False
+        # A PRE-PREPARE must come from the round's leader; storing
+        # non-leader proposals would let any peer squat PRE-PREPARE slots
+        # (and a validly-signed one is a protocol violation by its sender).
+        if msg.type == MsgType.PRE_PREPARE and msg.source != self.d.leader(
+            self.instance, msg.round
+        ):
+            if self._sender_authentic(msg):
+                self._evidence(msg.source, "qbft_malformed")
             return False
         # Bound + dedup justifications BEFORE signature verification: a
         # protocol-honest PRE-PREPARE carries at most a ROUND-CHANGE quorum
         # plus a PREPARE quorum (<= 2n distinct (type, source, round)
         # slots); anything larger or duplicated is a CPU-amplification
-        # attack (each entry costs an ECDSA verify).
+        # attack (each entry costs an ECDSA verify). Attribution costs one
+        # outer verify — same price the sender paid to send the frame.
         if len(msg.justification) > 2 * self.d.nodes:
+            if self._sender_authentic(msg):
+                self._evidence(msg.source, "qbft_malformed")
             return False
         seen: set = set()
         for j in msg.justification:
-            if not (0 <= j.source < self.d.nodes):
-                return False
             jkey = (j.type, j.source, j.round)
-            if jkey in seen:
+            if not (0 <= j.source < self.d.nodes) or jkey in seen:
+                if self._sender_authentic(msg):
+                    self._evidence(msg.source, "qbft_malformed")
                 return False
             seen.add(jkey)
-        if not self.d.is_valid(msg):
+        # Per-sender stored bound, checked before the full is_valid so a
+        # flood costs no justification-recursion verifies. Evidence is
+        # attributed once per source (one outer verify, then free drops).
+        n_stored = self._stored_per_source.get(msg.source, 0)
+        if n_stored >= self.d.max_stored_per_source:
+            self.flood_dropped += 1
+            if msg.source not in self._flood_flagged and (
+                self._sender_authentic(msg)
+            ):
+                self._flood_flagged.add(msg.source)
+                self._evidence(msg.source, "qbft_flood")
             return False
+        if not self.d.is_valid(msg):
+            if self.d.verify_sender is not None and self.d.verify_sender(
+                msg
+            ):
+                # The outer signature verifies but a piggybacked
+                # justification does not: the sender forged its
+                # justification (a garbage frame would have failed the
+                # outer check too, proving nothing about the source).
+                self._evidence(msg.source, "qbft_forged_justification")
+            return False
+        self._stored_per_source[msg.source] = n_stored + 1
         self.msgs[key] = msg
         return True
 

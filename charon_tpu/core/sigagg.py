@@ -45,9 +45,16 @@ class SigAgg:
     # duty's expiry so the coalescer's adaptive window shrinks instead
     # of overshooting a near-deadline aggregation
     clock: object | None = None
+    # optional core/evidence.EvidenceRegistry: lanes from peers with
+    # equivocation-class evidence (EXCLUSION_KINDS) are excluded from
+    # recombination while >= threshold clean lanes remain — the per-peer
+    # quarantine primitive applied to the aggregation path
+    evidence: object | None = None
 
     def __post_init__(self) -> None:
         self._subs: list[AggSub] = []
+        self.excluded_lanes = 0  # partials dropped on evidence
+        self.exclusion_fallbacks = 0  # exclusions waived for liveness
 
     def subscribe(self, sub: AggSub) -> None:
         self._subs.append(sub)
@@ -59,6 +66,12 @@ class SigAgg:
             return
         epoch = duty.slot // self.slots_per_epoch
 
+        excluded = (
+            self.evidence.excluded_shares()
+            if self.evidence is not None
+            else ()
+        )
+
         pubkeys: list[PubKey] = []
         partial_maps: list[dict[int, bytes]] = []
         templates: list[ParSignedData] = []
@@ -67,7 +80,23 @@ class SigAgg:
                 raise AggregationError(
                     f"insufficient partial signatures for {duty}/{pubkey}"
                 )
-            use = psigs[: self.threshold]
+            use = psigs
+            if excluded:
+                clean = [
+                    p for p in psigs if p.share_idx not in excluded
+                ]
+                if len(clean) >= self.threshold:
+                    self.excluded_lanes += len(psigs) - len(clean)
+                    use = clean
+                else:
+                    # liveness over suspicion: with fewer than t clean
+                    # lanes the duty would fail outright — recombine from
+                    # what arrived and let group verification arbitrate
+                    # (>= t honest peers always supply t clean lanes when
+                    # adversaries <= f, so this fires only under extra
+                    # crash/partition faults)
+                    self.exclusion_fallbacks += 1
+            use = use[: self.threshold]
             pubkeys.append(pubkey)
             partial_maps.append(
                 {p.share_idx: p.data.signature for p in use}

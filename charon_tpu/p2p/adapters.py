@@ -39,8 +39,14 @@ class TcpParSigTransport:
 
     async def _on_msg(self, from_idx: int, msg):
         if self.local is not None:
+            # channel identity: mesh node index -> 1-based share index,
+            # so receive() can attribute spoofed/invalid sets to the
+            # authenticated peer the frame arrived from
             await self.local.receive(
-                msg["duty"], msg["set"], tctx=msg.get("tctx")
+                msg["duty"],
+                msg["set"],
+                tctx=msg.get("tctx"),
+                sender=from_idx + 1,
             )
         return None
 
@@ -68,6 +74,10 @@ class TcpQbftNet:
     async def _on_msg(self, from_idx: int, m):
         if self.local is not None:
             self.local.deliver(
-                m["duty"], m["msg"], m["vals"], tctx=m.get("tctx")
+                m["duty"],
+                m["msg"],
+                m["vals"],
+                tctx=m.get("tctx"),
+                sender=from_idx,
             )
         return None

@@ -10,8 +10,8 @@ runs). This module is the one home for all of it:
   * **p2p / partial-signature transport** — drop, delay, duplicate,
     reorder and corrupt frames, asymmetric partitions, node crash and
     restart (`ChaosParSigTransport`, `ChaosMsgNet`, `chaos_p2p_node`,
-    `blast_garbage`). Supersedes the old `p2p/fuzz.py` stub, which now
-    delegates here.
+    `blast_garbage`, `fuzz_node`). The old `p2p/fuzz.py` stub is gone —
+    this module is the only home.
   * **beacon clients** — injected timeouts, 5xx error bursts, slow
     responses and stale-head data (`ChaosBeacon`), fed through the same
     duck-typed surface as `app/eth2wrap.MultiClient`.
@@ -228,16 +228,18 @@ class ChaosParSigTransport:
                 # context arrives as garbage too — receivers must fall
                 # back to a fresh duty-rooted span, never crash
                 frame_tctx = self._rng.randbytes(12).hex() + "-zz"
-            self._deliver(node, duty, payload, frame_tctx)
+            self._deliver(node, duty, payload, frame_tctx, from_idx)
             if self._rng.random() < self.cfg.duplicate:
                 self.duplicated += 1
-                self._deliver(node, duty, payload, frame_tctx)
+                self._deliver(node, duty, payload, frame_tctx, from_idx)
         if failed:
             raise ConnectionError(
                 f"chaos: delivery to peers {failed} failed"
             )
 
-    def _deliver(self, node, duty, signed_set, tctx=None) -> None:
+    def _deliver(
+        self, node, duty, signed_set, tctx=None, sender=None
+    ) -> None:
         async def run():
             # simulated network boundary: the delivery task inherits the
             # sender's contextvars — detach so trace context propagates
@@ -254,7 +256,9 @@ class ChaosParSigTransport:
                 return  # crashed while the frame was in flight
             try:
                 with detached():
-                    await node.receive(duty, signed_set, tctx=tctx)
+                    await node.receive(
+                        duty, signed_set, tctx=tctx, sender=sender
+                    )
             except Exception:  # noqa: BLE001 — receiver faults stay local
                 pass
 
@@ -301,21 +305,25 @@ class ChaosMsgNet:
                 continue
             if self._rng.random() < self.cfg.reorder + self.cfg.delay:
                 self.delayed += 1
-                self._late(node, duty, msg, values, tctx)
+                self._late(node, duty, msg, values, tctx, from_idx)
                 continue
             from charon_tpu.app.tracer import detached
 
             with detached():
-                node.deliver(duty, msg, values, tctx=tctx)
+                node.deliver(duty, msg, values, tctx=tctx, sender=from_idx)
 
-    def _late(self, node, duty, msg, values, tctx=None) -> None:
+    def _late(
+        self, node, duty, msg, values, tctx=None, sender=None
+    ) -> None:
         async def run():
             from charon_tpu.app.tracer import detached
 
             await asyncio.sleep(self._rng.uniform(0.0, self.cfg.delay_max))
             if node.node_idx not in self.part.crashed:
                 with detached():
-                    node.deliver(duty, msg, values, tctx=tctx)
+                    node.deliver(
+                        duty, msg, values, tctx=tctx, sender=sender
+                    )
 
         task = asyncio.create_task(run())
         self._tasks.add(task)
@@ -599,6 +607,21 @@ def chaos_p2p_node(node, cfg: ChaosConfig) -> None:
         return await orig_bcast_one(peer_idx, protocol, req_id, msg, cache)
 
     node._broadcast_one = chaotic_broadcast_one
+
+
+def fuzz_node(node, rate: float = 0.2, seed: int = 0) -> None:
+    """Convenience wrapper (absorbed from the deleted p2p/fuzz.py):
+    split one aggregate fault `rate` evenly across drop/corrupt/duplicate
+    and install the seeded p2p frame chaos on `node`."""
+    chaos_p2p_node(
+        node,
+        ChaosConfig(
+            seed=seed,
+            drop=rate / 3,
+            corrupt=rate / 3,
+            duplicate=rate / 3,
+        ),
+    )
 
 
 async def blast_garbage(

@@ -116,6 +116,10 @@ class SimNode:
     tracker: Tracker | None = None
     tracer: object | None = None  # app/tracer.Tracer (tracing=True builds)
     crypto_plane: object | None = None  # SlotCoalescer (crypto_plane=True)
+    parsigex: ParSigEx | None = None
+    # core/evidence.EvidenceRegistry — per-node Byzantine detections,
+    # same wiring as production (app/run.py)
+    evidence: object | None = None
 
 
 class SimHostPlane:
@@ -327,9 +331,17 @@ def _build_node(
             stats_hook=plane_span_bridge(node_tracer),
         )
 
+    from charon_tpu.core.evidence import EvidenceRegistry
+
+    evidence = EvidenceRegistry()
     dutydb = DutyDB()
-    parsigdb = ParSigDB(threshold=cluster.t)
-    sigagg = SigAgg(threshold=cluster.t, fork=fork, slots_per_epoch=spe)
+    parsigdb = ParSigDB(threshold=cluster.t, evidence=evidence)
+    sigagg = SigAgg(
+        threshold=cluster.t,
+        fork=fork,
+        slots_per_epoch=spe,
+        evidence=evidence,
+    )
     # flag-selected impl, mirroring production wiring (run.py)
     aggsigdb = new_agg_sigdb()
     bcast = Broadcaster(beacon=beacon, clock=beacon.clock())
@@ -344,6 +356,7 @@ def _build_node(
                 round_timeout=0.3,
                 timer="inc",
                 tracer=node_tracer,
+                evidence=evidence,
             )
         )
         # echo stays registered as a switchable alternate so priority
@@ -368,6 +381,7 @@ def _build_node(
         verifier,
         clock=beacon.clock(),
         tracer=node_tracer,
+        evidence=evidence,
     )
     scheduler = Scheduler(
         beacon,
@@ -489,4 +503,6 @@ def _build_node(
         tracker=tracker,
         tracer=node_tracer,
         crypto_plane=plane,
+        parsigex=parsigex,
+        evidence=evidence,
     )

@@ -5,7 +5,7 @@
 #   ./ci.sh fast      # default — workflow/networking/crypto-host tier
 #   ./ci.sh slow      # compile-heavy JAX kernels + multi-process harnesses
 #   ./ci.sh full      # both tiers
-#   ./ci.sh chaos     # seeded chaos scenarios only (subset of fast)
+#   ./ci.sh chaos     # seeded chaos + full Byzantine adversary battery
 #   ./ci.sh hostplane # event-loop-stall regression guard (subset of fast)
 #   ./ci.sh obs       # observability gate: monitoring endpoint + span export
 #   ./ci.sh analysis  # project-invariant linter + schema/metrics checkers
@@ -155,6 +155,13 @@ case "$TIER" in
     # flooding tenant degrades the victim's p99 < 2x while its own
     # over-budget load sheds).
     "${PYTEST[@]}" tests/test_chaos_scenarios.py tests/test_retry_backoff.py
+    # Byzantine adversary battery (ISSUE 16): the FULL seeded attack
+    # suite including the two slow-marked end-to-end scenarios (rogue
+    # partial-signature flood + real-share double-sign, both run under
+    # the differential device-vs-oracle tbls backend with a
+    # zero-mismatch gate) — the marker override re-selects them here;
+    # the fast tier already runs the 'not slow' subset via tests/.
+    "${PYTEST[@]}" tests/test_byzantine.py -m 'slow or not slow'
     exec python bench_hostplane.py --tenants
     ;;
   *)

@@ -172,12 +172,30 @@ def test_parsigdb_groups_by_message_root():
 
 
 def test_parsigdb_equivocation_detection():
+    # Double-sign no longer raises (ISSUE 16: a raise mid-batch aborted
+    # the remaining honest pubkeys) — first signature wins, the conflict
+    # is counted and attributed to the offending share index.
+    from charon_tpu.core.evidence import EvidenceRegistry
+
     async def run():
-        db = ParSigDB(threshold=3)
+        ev = EvidenceRegistry()
+        db = ParSigDB(threshold=2, evidence=ev)
         duty = Duty(5, DutyType.ATTESTER)
         await db.store_external(duty, {PK: _psig(1, sig=b"\x01" * 96)})
-        with pytest.raises(SigConflictError):
-            await db.store_external(duty, {PK: _psig(1, sig=b"\x02" * 96)})
+        await db.store_external(duty, {PK: _psig(1, sig=b"\x02" * 96)})
+        assert db.conflicts == 1
+        assert ev.count(peer=1, kind="parsig_conflict") == 1
+        assert ev.excluded_shares() == {1}
+        # the stored (first) signature still counts toward the threshold
+        got = []
+
+        async def on_threshold(d, ready):
+            got.append(ready)
+
+        db.subscribe_threshold(on_threshold)
+        await db.store_external(duty, {PK: _psig(2, sig=b"\x01" * 96)})
+        assert len(got) == 1
+        assert [p.data.signature for p in got[0][PK]] == [b"\x01" * 96] * 2
 
     asyncio.run(run())
 

@@ -8,7 +8,7 @@ import pytest
 
 from charon_tpu.app.peerinfo import PeerInfoService
 from charon_tpu.app.privkeylock import PrivKeyLock, PrivKeyLockError
-from charon_tpu.p2p.fuzz import blast_garbage, fuzz_node
+from charon_tpu.testutil.chaos import blast_garbage, fuzz_node
 from charon_tpu.p2p.relay import RelayClient, RelayServer
 
 from tests.test_p2p import make_mesh  # reuse mesh fixture helpers

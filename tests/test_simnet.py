@@ -293,3 +293,85 @@ def test_simnet_priority_switches_protocol_mid_run():
         )
 
     asyncio.run(run())
+
+
+def test_simnet_cross_slot_replay_attributed_to_channel():
+    """Cross-slot replay: a consensus message captured from one duty and
+    re-delivered under a DIFFERENT duty — or under its own duty but from
+    the wrong channel peer — is dropped at the adapter boundary before
+    any engine, transport, or value-cache state exists for it, and the
+    evidence ledger names the CHANNEL peer, not the original signer
+    (ISSUE 16 satellite: replay regression in the simnet path)."""
+
+    async def run():
+        cluster = build_cluster(
+            n=4, t=3, num_validators=1, slot_duration=0.8, use_qbft=True
+        )
+        adapters = [
+            node.consensus.current_consensus() for node in cluster.nodes
+        ]
+        assert adapters[0].protocol_id == "qbft/2.0.0"
+
+        # tap the QBFT fabric: capture every frame crossing the net
+        net = adapters[0].net
+        captured = []
+        orig_bcast = net.broadcast
+
+        async def tap(from_idx, duty, msg, values, tctx=None):
+            captured.append(msg)
+            await orig_bcast(from_idx, duty, msg, values, tctx=tctx)
+
+        net.broadcast = tap
+
+        tasks = [
+            asyncio.create_task(node.scheduler.run())
+            for node in cluster.nodes
+        ]
+        try:
+
+            async def consensus_traffic():
+                while not captured:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(consensus_traffic(), timeout=60)
+        finally:
+            for node in cluster.nodes:
+                node.scheduler.stop()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        from charon_tpu.core.types import Duty, DutyType
+
+        victim = adapters[0]
+        evidence = cluster.nodes[0].evidence
+        # honest run: nothing was ever flagged as replay
+        assert evidence.count(kind="qbft_replay") == 0
+
+        msg = captured[0]
+        # channel identities for the two replays: distinct from each
+        # other AND from the original signer, so the attribution asserts
+        # below can't collide
+        adversary, wrong_channel = [
+            i for i in range(4) if i != msg.source
+        ][:2]
+
+        # cross-slot replay: duty-A traffic re-delivered under duty B
+        replay_duty = Duty(msg.instance.slot + 1000, DutyType.ATTESTER)
+        instances_before = set(victim._instances)
+        values_before = set(victim._values)
+        victim.deliver(replay_duty, msg, {}, sender=adversary)
+        assert evidence.count(peer=adversary + 1, kind="qbft_replay") == 1
+
+        # stale replay on the RIGHT duty but the WRONG channel: the frame
+        # carries an honest original signer, so only the channel can be
+        # blamed — and it is
+        victim.deliver(msg.instance, msg, {}, sender=wrong_channel)
+        assert evidence.count(peer=wrong_channel + 1, kind="qbft_replay") == 1
+
+        # the original signer was never framed by either replay
+        assert evidence.count(peer=msg.source + 1, kind="qbft_replay") == 0
+        # and no adapter state materialised for the replayed duty
+        assert set(victim._instances) == instances_before
+        assert set(victim._values) == values_before
+        assert replay_duty not in victim._instances
+
+    asyncio.run(run())
