@@ -485,7 +485,126 @@ async def tenants_ab(args) -> tuple[dict, bool]:
     return report, ok
 
 
+async def _remote_phase(items, duties: int, device_s: float,
+                        remote: bool):
+    """Mean submit->result latency for `duties` verify bursts through
+    core/cryptosvc — either holding the TenantPlane directly
+    (in-process baseline) or dialing it through the full socket path
+    (cryptosvc_server + cryptosvc_client on localhost)."""
+    from charon_tpu.core.cryptoplane import SlotCoalescer
+    from charon_tpu.core.cryptosvc import CryptoPlaneService, TenantQuota
+
+    _clear_decode_caches()
+    plane = SimPlane(t=3, device_s=device_s)
+    coal = SlotCoalescer(
+        plane, window=0.01, decode_workers=2, decode_mode="device"
+    )
+    svc = CryptoPlaneService(coal, round_lanes=4096)
+    tenant = svc.register("bench", TenantQuota(max_queue_lanes=4096))
+    server = client = None
+    handle = tenant
+    try:
+        if remote:
+            from charon_tpu.core.cryptosvc_client import RemotePlane
+            from charon_tpu.core.cryptosvc_server import (
+                CryptoServiceServer,
+            )
+
+            server = CryptoServiceServer(
+                svc, {"bench": "bench-token"}, port=0
+            )
+            await server.start()
+            client = RemotePlane(
+                "127.0.0.1", server.port, "bench", "bench-token",
+                local=tenant,
+            )
+            await client.start()
+            # the A/B measures REMOTE dispatch: wait out the first
+            # connect so no duty silently runs on the local rung
+            for _ in range(200):
+                if client.state != "down":
+                    break
+                await asyncio.sleep(0.01)
+            handle = client
+        latencies: list[float] = []
+        for i in range(duties + 3):
+            t0 = time.monotonic()
+            res = await handle.verify(
+                list(items), deadline=time.time() + 5.0
+            )
+            if i >= 3:  # first duties pay cold point-cache decodes
+                latencies.append(time.monotonic() - t0)
+            assert all(res)
+        if remote:
+            # a failover mid-bench would compare local against local
+            assert client.remote_jobs >= duties, (
+                f"only {client.remote_jobs}/{duties} duties dispatched "
+                f"remotely (failovers: {client.failovers})"
+            )
+    finally:
+        if client is not None:
+            await client.close()
+        if server is not None:
+            await server.close()
+        svc.close()
+        coal.close()
+    return {
+        "mean_seconds": round(sum(latencies) / len(latencies), 4),
+        "max_seconds": round(max(latencies), 4),
+    }
+
+
+async def remote_ab(args) -> tuple[dict, bool]:
+    """Remote-dispatch overhead gate (ISSUE 17): the full socket path
+    (codec frames + localhost TCP + stats briefs) must stay under
+    --assert-remote-ratio of holding the TenantPlane in-process, at
+    the full --lanes burst (remeasured once — CI-noise discipline)."""
+    items = make_burst(args.lanes)
+    duties = 12 if args.smoke else 20
+
+    async def measure():
+        local = await _remote_phase(items, duties, 0.02, False)
+        remote = await _remote_phase(items, duties, 0.02, True)
+        ratio = remote["mean_seconds"] / max(local["mean_seconds"], 1e-6)
+        return local, remote, ratio
+
+    local, remote, ratio = await measure()
+    want = args.assert_remote_ratio
+    if want and ratio >= want:
+        print(f"# remote ratio {ratio:.2f}x (want < {want}x) — "
+              f"remeasuring")
+        local, remote, ratio = await measure()
+    ok = not want or ratio < want
+    report = {
+        "lanes": len(items),
+        "in_process": local,
+        "remote": remote,
+        "remote_overhead_ratio": round(ratio, 2),
+    }
+    print(
+        f"# remote dispatch: mean {local['mean_seconds'] * 1000:.0f} ms "
+        f"in-process -> {remote['mean_seconds'] * 1000:.0f} ms over "
+        f"sockets ({ratio:.2f}x, want < {want}x) at {len(items)} lanes"
+    )
+    return report, ok
+
+
 async def main(args) -> int:
+    if args.remote:
+        # remote crypto-plane dispatch overhead gate (ISSUE 17):
+        # jax-free, SimPlane device, real sockets on localhost
+        report, ok = await remote_ab(args)
+        print(json.dumps({"bench": "hostplane-remote", **report},
+                         indent=2))
+        if not ok:
+            print(
+                f"FAIL: remote dispatch overhead "
+                f"{report['remote_overhead_ratio']}x (want < "
+                f"{args.assert_remote_ratio}x in-process)"
+            )
+            return 1
+        print("remote PASS")
+        return 0
     if args.tenants:
         # standalone multi-tenant isolation gate (ISSUE 8): jax-free,
         # SimPlane device — the ci.sh chaos/hostplane tiers' A/B
@@ -675,4 +794,12 @@ if __name__ == "__main__":
                     help="with --tenants: fail unless the victim "
                     "tenant's p99 latency under flood stays below this "
                     "multiple of its unflooded baseline")
+    ap.add_argument("--remote", action="store_true",
+                    help="remote crypto-plane A/B (ISSUE 17): mean "
+                    "verify latency holding the TenantPlane in-process "
+                    "vs dialing it through cryptosvc_server/_client "
+                    "over localhost sockets at --lanes lanes")
+    ap.add_argument("--assert-remote-ratio", type=float, default=2.0,
+                    help="with --remote: fail unless the socket path "
+                    "stays below this multiple of in-process dispatch")
     raise SystemExit(asyncio.run(main(ap.parse_args())))

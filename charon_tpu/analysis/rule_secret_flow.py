@@ -78,6 +78,18 @@ SECRET_NAMES = frozenset(
         "ikm",
         "shares",
         "_polys",
+        # remote crypto-plane tenant auth (ISSUE 17): the service token
+        # is a bearer secret — only its HMAC proof may cross the wire.
+        # Deliberately NOT bare "token": tracer contextvar tokens and
+        # cancellation tokens are not secrets.
+        "auth_token",
+        "auth_tokens",
+        "_auth_token",
+        "_auth_tokens",
+        "tenant_token",
+        "tenant_tokens",
+        "crypto_remote_token",
+        "crypto_serve_tokens",
     }
 )
 

@@ -359,6 +359,55 @@ class ClusterMetrics:
             registry=self.registry,
             buckets=(0.005, 0.02, 0.05, 0.1, 0.5, 2.0, 10.0, 60.0),
         )
+        # remote crypto plane (ISSUE 17): the client-side view of the
+        # networked service rung — every failover to the local ladder,
+        # window/remote sheds, connection churn and rung state, all
+        # attributed to the dialing tenant
+        self.plane_remote_failovers = Counter(
+            "tpu_plane_remote_failovers_total",
+            "Jobs degraded from the remote crypto plane to the local "
+            "ladder, by tenant and failure reason (down, probing, io, "
+            "codec, timeout, heartbeat, shed, remote_error)",
+            labels + ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.plane_remote_failover_lanes = Counter(
+            "tpu_plane_remote_failover_lanes_total",
+            "Crypto lanes served by the local ladder after a remote "
+            "failure, by tenant and failure reason",
+            labels + ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.plane_remote_shed = Counter(
+            "tpu_plane_remote_shed_total",
+            "Typed sheds on the remote rung by tenant and reason: the "
+            "client's bounded in-flight window (jobs, lanes) and "
+            "server admission sheds relayed as CryptoShed frames "
+            "(remote_jobs, remote_lanes, remote_closed)",
+            labels + ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.plane_remote_connects = Counter(
+            "tpu_plane_remote_connects_total",
+            "Authenticated connections established to the remote "
+            "crypto-plane service, by tenant (first dial + reconnects)",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
+        self.plane_remote_disconnects = Counter(
+            "tpu_plane_remote_disconnects_total",
+            "Remote crypto-plane connections torn down, by tenant and "
+            "reason (io, codec, heartbeat, closed)",
+            labels + ["tenant", "reason"],
+            registry=self.registry,
+        )
+        self.plane_remote_state = Gauge(
+            "tpu_plane_remote_state",
+            "Remote crypto-plane rung state per tenant "
+            "(0 = down/local-only, 1 = probing half-open, 2 = up)",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
         # duty-rooted tracing (ISSUE 4): per-step latency from span
         # ends plus the slow-duty detector's wall-time/budget verdicts
         self.step_latency = Histogram(
@@ -465,6 +514,45 @@ class ClusterMetrics:
                 )
                 if f.get("quarantined"):
                     self.labels(self.plane_tenant_quarantined, tenant).inc()
+
+        return hook
+
+    def remote_hook(self, tenant: str):
+        """core/cryptosvc_client.RemotePlane observer sink: typed
+        client events -> the tenant-labeled remote-plane families.
+        Tenant identity is bound once here — the client never passes
+        labels (and MUST never pass secrets) into metrics."""
+        state_value = {"down": 0, "probing": 1, "up": 2}
+
+        def hook(kind: str, **f) -> None:
+            if kind == "failover":
+                reason = f.get("reason", "unknown")
+                self.labels(
+                    self.plane_remote_failovers, tenant, reason
+                ).inc()
+                self.labels(
+                    self.plane_remote_failover_lanes, tenant, reason
+                ).inc(f.get("lanes", 0))
+            elif kind == "shed":
+                self.labels(
+                    self.plane_remote_shed, tenant, f["reason"]
+                ).inc()
+            elif kind == "remote_shed":
+                self.labels(
+                    self.plane_remote_shed,
+                    tenant,
+                    f"remote_{f['reason']}",
+                ).inc()
+            elif kind == "connect":
+                self.labels(self.plane_remote_connects, tenant).inc()
+            elif kind == "disconnect":
+                self.labels(
+                    self.plane_remote_disconnects, tenant, f["reason"]
+                ).inc()
+            elif kind == "state":
+                self.labels(self.plane_remote_state, tenant).set(
+                    state_value.get(f["state"], 0)
+                )
 
         return hook
 

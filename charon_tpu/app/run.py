@@ -118,6 +118,24 @@ class Config:
     crypto_plane_round_lanes: int = 4096  # total admission per round
     crypto_breaker_threshold: float = 0.5  # failed ratio that opens
     crypto_breaker_cooldown: float = 5.0  # seconds open -> half-open
+    # networked crypto plane (ISSUE 17, core/cryptosvc_client): dial a
+    # remote CryptoServiceServer at "host:port". The remote service is
+    # a rung ABOVE the local plane: any remote failure (refused
+    # connect, heartbeat miss, mid-flush socket death, malformed frame,
+    # shed) degrades the affected jobs down the local tbls ladder —
+    # never a single point of failure. "" keeps everything in-process.
+    crypto_remote: str = ""
+    # tenant auth token for the remote service handshake. repr=False:
+    # the token must never reach logs, reprs or metrics labels
+    # (analysis/rule_secret_flow enforces this).
+    crypto_remote_token: str = field(default="", repr=False)
+    # serve THIS node's CryptoPlaneService over TCP so other clusters
+    # can share the device mesh (core/cryptosvc_server). None = off;
+    # 0 = ephemeral port (resolved at start, Node.crypto_server.port).
+    crypto_serve: int | None = None
+    crypto_serve_host: str = "127.0.0.1"
+    # tenant_id -> auth token for dialing clusters (repr=False: secret)
+    crypto_serve_tokens: dict = field(default_factory=dict, repr=False)
 
 
 @dataclass
@@ -138,6 +156,8 @@ class Node:
     sigagg: SigAgg | None = None
     crypto_plane: object | None = None  # core.cryptoplane.SlotCoalescer
     crypto_svc: object | None = None  # core.cryptosvc.CryptoPlaneService
+    crypto_remote_plane: object | None = None  # cryptosvc_client.RemotePlane
+    crypto_server: object | None = None  # cryptosvc_server.CryptoServiceServer
     inclusion: InclusionChecker | None = None
 
     async def rewarm_point_caches(
@@ -233,6 +253,8 @@ async def build_node(config: Config) -> Node:
     crypto_plane = None
     crypto_svc = None
     tenant_plane = None  # the handle components hold (core/cryptosvc)
+    remote_plane = None  # cryptosvc_client.RemotePlane when configured
+    crypto_server = None  # cryptosvc_server.CryptoServiceServer
     if config.use_tpu_tbls:
         from charon_tpu.tbls.tpu_impl import TPUImpl
 
@@ -424,6 +446,49 @@ async def build_node(config: Config) -> Node:
             queue_lanes=config.crypto_tenant_queue_lanes,
             round_lanes=config.crypto_plane_round_lanes,
         )
+
+        # networked crypto plane (ISSUE 17): dial a shared remote
+        # service; the just-registered local tenant plane becomes the
+        # always-available rung below. The same span bridge that feeds
+        # local FlushStats into duty traces receives the remote
+        # attribution briefs (rebased onto this host's clock), so
+        # operators see one consistent trace either way.
+        if config.crypto_remote:
+            from charon_tpu.core.cryptosvc_client import RemotePlane
+
+            r_host, _, r_port = config.crypto_remote.rpartition(":")
+            remote_plane = RemotePlane(
+                r_host or "127.0.0.1",
+                int(r_port),
+                tenant_id,
+                config.crypto_remote_token,
+                local=tenant_plane,
+                observer=metrics.remote_hook(tenant_id),
+                stats_hook=crypto_plane.stats_hook,
+            )
+            tenant_plane = remote_plane
+            log.info(
+                "remote crypto plane configured",
+                topic="app",
+                addr=remote_plane.addr,
+                tenant=tenant_id,
+            )
+
+        # expose this node's service to other clusters (the serving
+        # side of the same topology; tenants register with default
+        # quotas on start unless pre-registered above)
+        if config.crypto_serve is not None:
+            from charon_tpu.core.cryptosvc_server import (
+                CryptoServiceServer,
+            )
+
+            crypto_server = CryptoServiceServer(
+                crypto_svc,
+                config.crypto_serve_tokens,
+                host=config.crypto_serve_host,
+                port=config.crypto_serve,
+                register_tenants=True,
+            )
 
     # -- beacon client ----------------------------------------------------
     import time as _time
@@ -911,6 +976,36 @@ async def build_node(config: Config) -> Node:
 
         life.register_stop(Order.SCHEDULER, "crypto-plane", stop_plane)
 
+    if remote_plane is not None:
+        # connection supervision starts with the node; jobs submitted
+        # while the remote is down simply run on the local rung
+        life.register_start(
+            Order.MONITORING, "crypto-remote", remote_plane.start
+        )
+        life.register_stop(
+            Order.SCHEDULER, "crypto-remote", remote_plane.close
+        )
+
+    if crypto_server is not None:
+
+        async def start_crypto_server():
+            await crypto_server.start()
+            # tenant IDS only — the token VALUES never leave the dict
+            log.info(  # lint: allow(secret-flow)
+                "crypto plane service listening",
+                topic="app",
+                host=crypto_server.host,
+                port=crypto_server.port,
+                tenants=sorted(config.crypto_serve_tokens),
+            )
+
+        life.register_start(
+            Order.MONITORING, "crypto-serve", start_crypto_server
+        )
+        life.register_stop(
+            Order.SCHEDULER, "crypto-serve", crypto_server.close
+        )
+
     if config.use_tpu_tbls:
         # bulk point-cache warm-up (ISSUE 6): decode the whole cluster
         # key set through the batched device kernels at startup so the
@@ -1092,6 +1187,8 @@ async def build_node(config: Config) -> Node:
         sigagg=sigagg,
         crypto_plane=crypto_plane,
         crypto_svc=crypto_svc,
+        crypto_remote_plane=remote_plane,
+        crypto_server=crypto_server,
         inclusion=inclusion,
     )
 

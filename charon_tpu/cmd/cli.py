@@ -227,6 +227,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds an open breaker waits before half-opening (one "
         "clean quarantined flush then closes it)",
     )
+    # networked crypto plane (ISSUE 17). The auth token deliberately
+    # has NO flag: tokens on the command line leak via ps/shell
+    # history, so the env var is the only channel.
+    runp.add_argument(
+        "--crypto-remote",
+        default=_env_default("crypto-remote", ""),
+        help="host:port of a remote crypto-plane service to dial "
+        "(core/cryptosvc_client); token via CHARON_TPU_CRYPTO_TOKEN "
+        "env var only. Remote failures degrade to the local ladder.",
+    )
+    runp.add_argument(
+        "--crypto-serve",
+        type=int,
+        default=int(_env_default("crypto-serve", -1)),
+        help="TCP port to serve this node's crypto-plane service on "
+        "(core/cryptosvc_server); 0 = ephemeral, -1/unset = off. "
+        "Tenant tokens via CHARON_TPU_CRYPTO_SERVE_TOKENS "
+        "('tenant=token,tenant2=token2') env var only.",
+    )
+    runp.add_argument(
+        "--crypto-serve-host",
+        default=_env_default("crypto-serve-host", "127.0.0.1"),
+        help="bind address for --crypto-serve",
+    )
     runp.add_argument(
         "--relay",
         default=_env_default("relay", ""),
@@ -523,8 +547,9 @@ def cmd_create_cluster(args) -> int:
 
 
 def cmd_run(args) -> int:
-    from charon_tpu.app.run import Config, run
-
+    # flag validation runs BEFORE the app.run import: bad flags must
+    # fail fast with a clean diagnostic even on hosts where the node
+    # stack's optional dependencies are absent
     if args.crypto_plane not in ("auto", "on", "off"):
         # env-var default bypassed argparse choices validation
         print(
@@ -569,11 +594,60 @@ def cmd_run(args) -> int:
             print(f"--fault-injection: {e}", file=sys.stderr)
             return 2
 
+    # networked crypto plane (ISSUE 17): validate the address shape
+    # here (fail fast) and pull secrets from env only — never argv
+    crypto_remote_token = ""
+    if args.crypto_remote:
+        host, sep, port = args.crypto_remote.rpartition(":")
+        if not sep or not port.isdigit():
+            print(
+                f"--crypto-remote {args.crypto_remote!r}: "
+                "must be host:port",
+                file=sys.stderr,
+            )
+            return 2
+        crypto_remote_token = os.environ.get(
+            "CHARON_TPU_CRYPTO_TOKEN", ""
+        )
+        if not crypto_remote_token:
+            print(
+                "--crypto-remote requires the CHARON_TPU_CRYPTO_TOKEN "
+                "environment variable (tokens never go on argv)",
+                file=sys.stderr,
+            )
+            return 2
+    crypto_serve_tokens = {}
+    if args.crypto_serve >= 0:
+        raw = os.environ.get("CHARON_TPU_CRYPTO_SERVE_TOKENS", "")
+        for part in raw.split(","):
+            if not part.strip():
+                continue
+            tenant, sep, token = part.partition("=")
+            if not sep or not tenant.strip() or not token:
+                print(
+                    "CHARON_TPU_CRYPTO_SERVE_TOKENS: entries must be "
+                    "'tenant=token', comma-separated",
+                    file=sys.stderr,
+                )
+                return 2
+            crypto_serve_tokens[tenant.strip()] = token
+        if not crypto_serve_tokens:
+            print(
+                "--crypto-serve requires CHARON_TPU_CRYPTO_SERVE_TOKENS "
+                "('tenant=token,...'); refusing to serve with no "
+                "authenticated tenants",
+                file=sys.stderr,
+            )
+            return 2
+
     peer_addrs = []
     if args.peers:
         for part in args.peers.split(","):
             host, port = part.rsplit(":", 1)
             peer_addrs.append((host, int(port)))
+
+    from charon_tpu.app.run import Config, run
+
     config = Config(
         data_dir=args.data_dir,
         node_index=args.node_index,
@@ -602,6 +676,11 @@ def cmd_run(args) -> int:
         crypto_plane_round_lanes=args.crypto_plane_round_lanes,
         crypto_breaker_threshold=args.crypto_breaker_threshold,
         crypto_breaker_cooldown=args.crypto_breaker_cooldown,
+        crypto_remote=args.crypto_remote,
+        crypto_remote_token=crypto_remote_token,
+        crypto_serve=args.crypto_serve if args.crypto_serve >= 0 else None,
+        crypto_serve_host=args.crypto_serve_host,
+        crypto_serve_tokens=crypto_serve_tokens,
         tracing_endpoint=args.tracing_endpoint,
         tracing_jsonl=args.tracing_jsonl,
         relay_addr=args.relay,

@@ -9,7 +9,7 @@ deliver each node's share keys directly to its validator client
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import aiohttp
 
@@ -17,7 +17,9 @@ import aiohttp
 @dataclass
 class KeymanagerClient:
     base_url: str  # e.g. http://localhost:7500
-    auth_token: str = ""  # bearer token (keymanager API standard auth)
+    # bearer token (keymanager API standard auth); repr=False keeps it
+    # out of tracebacks/log formatting of the client object
+    auth_token: str = field(default="", repr=False)
     timeout: float = 10.0
 
     async def import_keystores(
@@ -33,7 +35,8 @@ class KeymanagerClient:
         }
         headers = {"Content-Type": "application/json"}
         if self.auth_token:
-            headers["Authorization"] = f"Bearer {self.auth_token}"
+            # the Authorization header IS the token's destination
+            headers["Authorization"] = f"Bearer {self.auth_token}"  # lint: allow(secret-flow)
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=self.timeout)
         ) as session:

@@ -79,6 +79,15 @@ _TYPE_WIRE_IDS: dict[str, int] = {
     "Msg": 18,  # qbft.Msg
     "PriorityMsg": 19,
     "TopicResult": 20,
+    # remote crypto-plane RPC frames (core/cryptosvc_wire) — appended,
+    # never renumbered, like everything above
+    "CryptoChallenge": 21,
+    "CryptoHello": 22,
+    "CryptoHelloAck": 23,
+    "CryptoSubmit": 24,
+    "CryptoResult": 25,
+    "CryptoShed": 26,
+    "CryptoHeartbeat": 27,
 }
 
 _ENUM_WIRE_IDS: dict[str, int] = {
@@ -1219,6 +1228,11 @@ def _register_core_types() -> None:
 
     register(priority.PriorityMsg)
     register(priority.TopicResult)
+
+    # remote crypto-plane RPC frames self-register on import (their
+    # wire ids live in _TYPE_WIRE_IDS above; the schema golden check
+    # snapshots them through this import)
+    from charon_tpu.core import cryptosvc_wire  # noqa: F401
 
 
 _register_core_types()

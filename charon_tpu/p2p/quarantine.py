@@ -27,7 +27,13 @@ class PeerQuarantine:
 
     observer(peer, mute_seconds) fires once per imposed mute (the
     transport chains logging + the wire_peer_quarantine_total metric
-    through it)."""
+    through it).
+
+    `exempt` peers (any hashable id — the crypto-service client keys by
+    "host:port") still accumulate strike counts for observability but
+    NEVER escalate into a mute: a client's own configured server
+    address flapping mid-upgrade should trigger reconnect backoff, not
+    a 300 s codec mute that silently extends the outage."""
 
     def __init__(
         self,
@@ -37,12 +43,14 @@ class PeerQuarantine:
         max_mute: float = QUARANTINE_MAX,
         observer=None,
         clock=time.monotonic,
+        exempt=(),
     ) -> None:
         self.strikes = strikes
         self.window = window
         self.base = base
         self.max_mute = max_mute
         self.observer = observer
+        self.exempt = frozenset(exempt)
         self._clock = clock
         self._strikes: dict[int, list[float]] = {}
         self._until: dict[int, float] = {}
@@ -50,6 +58,8 @@ class PeerQuarantine:
         self.quarantines = 0  # mutes imposed (wire_peer_quarantine_total)
 
     def muted(self, peer: int) -> bool:
+        if peer in self.exempt:
+            return False
         return self._clock() < self._until.get(peer, 0.0)
 
     def strike(self, peer: int) -> float | None:
@@ -60,6 +70,8 @@ class PeerQuarantine:
         strikes.append(now)
         while strikes and now - strikes[0] > self.window:
             strikes.pop(0)
+        if peer in self.exempt:
+            return None  # pinned address: backoff owns flap handling
         if len(strikes) < self.strikes:
             return None
         strikes.clear()

@@ -624,6 +624,139 @@ def fuzz_node(node, rate: float = 0.2, seed: int = 0) -> None:
     )
 
 
+# -- remote crypto-service socket chaos (ISSUE 17) ---------------------------
+
+
+class ChaosServiceProxy:
+    """Seeded TCP chaos proxy for the remote crypto-plane socket: sits
+    between `core/cryptosvc_client.RemotePlane` and
+    `core/cryptosvc_server.CryptoServiceServer` forwarding raw bytes
+    with injectable faults, so the client's failover ladder is
+    exercised against *socket-level* misbehavior (not just polite
+    server errors):
+
+      * `partition()` / `heal()` — live connections blackhole silently
+        (frames vanish mid-stream; only the heartbeat miss can notice)
+        and new dials are refused;
+      * `slow_drip` — per-chunk forwarding delay (a congested or
+        rate-limited path; deadline propagation must fail jobs over
+        before the duty expires);
+      * `corrupt` — per-chunk probability of mangled bytes, which
+        desyncs the length-prefixed framing and must surface as a
+        typed CodecError teardown + reconnect, never a crash;
+      * `kill_connections()` — abort every proxied socket (the
+        mid-flush SIGKILL stand-in when the real server object must
+        survive for assertions).
+
+    Fault state is mutable mid-run — scenarios script phases against
+    one proxy instance.
+    """
+
+    def __init__(
+        self,
+        upstream_host: str,
+        upstream_port: int,
+        cfg: ChaosConfig | None = None,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self.upstream = (upstream_host, upstream_port)
+        self.cfg = cfg or ChaosConfig()
+        self.host = host
+        self.port = 0
+        self._rng = self.cfg.stream("cryptosvc-proxy")
+        self._server: asyncio.base_events.Server | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        self._tasks: set[asyncio.Task] = set()
+        self.partitioned = False
+        self.slow_drip = 0.0  # seconds of added delay per chunk
+        self.corrupt = 0.0  # per-chunk corruption probability
+        # observability: scenarios assert the faults actually fired
+        self.chunks = 0
+        self.corrupted = 0
+        self.swallowed = 0
+        self.kills = 0
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, self.host, 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.kill_connections()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def partition(self) -> None:
+        """Blackhole: live streams swallow bytes, new dials are cut."""
+        self.partitioned = True
+
+    def heal(self) -> None:
+        self.partitioned = False
+
+    def kill_connections(self) -> None:
+        self.kills += 1
+        for w in list(self._writers):
+            if w.transport is not None:
+                w.transport.abort()
+        self._writers.clear()
+
+    async def _accept(self, reader, writer) -> None:
+        self._writers.add(writer)
+        if self.partitioned:
+            writer.close()
+            self._writers.discard(writer)
+            return
+        try:
+            up_reader, up_writer = await asyncio.open_connection(
+                *self.upstream
+            )
+        except OSError:
+            writer.close()
+            self._writers.discard(writer)
+            return
+        self._writers.add(up_writer)
+        for src, dst in (
+            (reader, up_writer),
+            (up_reader, writer),
+        ):
+            task = asyncio.create_task(self._pump(src, dst))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _pump(self, src, dst) -> None:
+        try:
+            while True:
+                chunk = await src.read(65536)
+                if not chunk:
+                    break
+                self.chunks += 1
+                if self.partitioned:
+                    self.swallowed += 1
+                    continue  # silent blackhole, like real packet loss
+                if self.slow_drip:
+                    await asyncio.sleep(self.slow_drip)
+                if self.corrupt and self._rng.random() < self.corrupt:
+                    self.corrupted += 1
+                    b = bytearray(chunk)
+                    for _ in range(max(1, len(b) // 64)):
+                        b[self._rng.randrange(len(b))] ^= 0xFF
+                    chunk = bytes(b)
+                dst.write(chunk)
+                await dst.drain()
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                dst.close()
+            except RuntimeError:
+                pass
+            self._writers.discard(dst)
+
+
 async def blast_garbage(
     host: str, port: int, n_frames: int = 50, seed: int = 0
 ) -> None:

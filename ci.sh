@@ -162,7 +162,17 @@ case "$TIER" in
     # zero-mismatch gate) — the marker override re-selects them here;
     # the fast tier already runs the 'not slow' subset via tests/.
     "${PYTEST[@]}" tests/test_byzantine.py -m 'slow or not slow'
-    exec python bench_hostplane.py --tenants
+    # Remote crypto-plane service chaos (ISSUE 17, jax-free, SimPlane
+    # device over real localhost sockets): server SIGKILL mid-flush,
+    # partitions, corrupt frames, slow drips — every affected duty
+    # degrades down the local tbls ladder (zero missed), reconnect
+    # resumes remote serving, and failover/shed counters attribute
+    # every event to the right tenant.
+    "${PYTEST[@]}" tests/test_cryptosvc_chaos.py tests/test_cryptosvc_remote.py
+    python bench_hostplane.py --tenants
+    # remote dispatch overhead gate: the socket path (codec frames +
+    # localhost TCP + stats briefs) stays < 2x in-process at 256 lanes
+    exec python bench_hostplane.py --remote --smoke
     ;;
   *)
     echo "usage: $0 [fast|slow|full|chaos|hostplane|obs]" >&2

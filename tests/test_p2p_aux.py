@@ -6,6 +6,14 @@ import json
 
 import pytest
 
+# the node-identity stack (app/k1util, eth2util/keystore) needs the
+# optional `cryptography` package; skip LOUDLY where absent instead
+# of erroring at collection (ISSUE 17 satellite — no test deleted)
+pytest.importorskip(
+    "cryptography",
+    reason="app.k1util requires the optional 'cryptography' package",
+)
+
 from charon_tpu.app.peerinfo import PeerInfoService
 from charon_tpu.app.privkeylock import PrivKeyLock, PrivKeyLockError
 from charon_tpu.testutil.chaos import blast_garbage, fuzz_node
