@@ -207,17 +207,25 @@ def main() -> None:
     # "without msm" first: the Pippenger randomization stage is the
     # newest kernel family — a compiler regression there falls back to
     # the proven per-lane double-and-add (the round-4 1664 sigs/s path)
+    # deploy-pinned env overrides (CHARON_MSM=0 etc., e.g. the TPU-watch
+    # msm_off gate): the ops hot paths no longer read the environment,
+    # so the baseline must re-assert them itself (core/autotune owns the
+    # fold-in; absent vars resolve to None = kernel default)
+    from charon_tpu.core.autotune import env_overrides
+
+    _env_pins = env_overrides()
+
     def apply_baseline():
         """Restore the full fast path. Called before every batch attempt
         so a SIZE-induced failure (e.g. OOM at 16384) cannot burn rungs
         that then silently degrade the smaller batch's measurement."""
-        MSM.set_msm(None)
+        MSM.set_msm(_env_pins.get("msm"))
         limb.set_pallas(None)
         if bench_mxu:
             limb.set_mxu(True)
             FT.set_fp2_fusion(False)
         else:
-            limb.set_mxu(None)
+            limb.set_mxu(_env_pins.get("mxu_mont"))
             FT.set_fp2_fusion(True)
 
     def fresh_rungs():

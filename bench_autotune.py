@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""bench_autotune.py — kernel auto-tuner + AOT compile-artifact gates
+(ISSUE 18 acceptance).
+
+Measures the two boot paths `core/autotune.resolve` gives a node and
+FAILS (exit 1) when either regresses:
+
+  * COLD — no profile on disk: `resolve("force")` micro-benches the
+    candidate axes on the bucket-ladder shape, persists the profile,
+    then `aot_prewarm` pushes the chosen variants through the
+    persistent compilation cache (jaxcache.py).
+  * WARM — profile + seeded cache: `resolve("auto")` must be a PURE
+    profile load (outcome "hit", zero bench runs, zero new cache
+    entries) and the warm wall must come in under --assert-warm-frac
+    (default 0.10) of the cold wall — the seconds-not-minutes fleet
+    cold-start gate. Remeasured once before a verdict (CI-noise
+    discipline).
+
+What the warm fraction covers is geometry-dependent
+(--warm-frac-scope): the TUNE step (micro-bench + persist vs pure
+profile load) amortizes everywhere — measured ~40,000x on the CI
+geometry — and `--smoke` gates THAT at < 10%. The full boot wall
+(tune + prewarm, scope `boot`, the non-smoke default) additionally
+pays one re-TRACE per prewarmed program on every boot; the persistent
+cache removes only the XLA-compile term. On the 1-core opt-0 XLA:CPU
+CI geometry trace ~= compile (~40 s each for the 4-lane recombine), so
+the boot-scope ratio floors near 35% REGARDLESS of artifact reuse —
+the < 10% boot gate is meaningful exactly where compile dominates
+trace (opt-3, real accelerators, the minutes-long pairing compiles),
+which is where non-smoke runs happen. Smoke still hard-gates the
+artifact story via zero-new-entries: a warm prewarm that RECOMPILES
+instead of replaying cache entries fails regardless of wall clock.
+
+Two more gates ride the same process:
+
+  * tuned-not-worst — a static msm on/off A/B at --burst-lanes; the
+    tuner's choice must not be slower than the WORST static config by
+    more than --assert-burst-tol (measured twice before concluding).
+    `--smoke` bursts at 8 lanes (a 256-lane A/B costs minutes of
+    dispatch on a 1-core CPU host); accelerator runs keep the 256
+    default.
+  * digest invalidation — tampering the persisted profile's
+    source_digest must provably re-tune (outcome "tuned", bench runs
+    > 0, a "stale" profile event) instead of trusting a profile blessed
+    against different kernel sources.
+
+The bench shares the repo's persistent jit cache (jaxcache.configure),
+so the first-ever run pays real XLA:CPU compiles (~6-8 min at opt-0)
+and every later run replays them as cache loads — the same artifact
+story the fleet rides. The tuner profile itself goes to a throwaway
+temp dir so the cold path genuinely micro-benches every run.
+
+`--smoke` (ci.sh fast tail + hostplane tier) runs tune lanes 4 /
+reps 3 / burst 8 and enforces all four gates.
+"""
+
+from __future__ import annotations
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    # Canonical flag string — EXACTLY tests/conftest.py's — so the bench,
+    # pytest, and the driver dryrun share persistent-cache entries for
+    # the same programs.
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8"
+        " --xla_backend_optimization_level=0"
+    )
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+
+def _wall(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    return out, time.perf_counter() - t0
+
+
+def _boot(at, mode, path, args, events):
+    """resolve + prewarm at the tune shape == one node boot. Returns
+    (result, tune_seconds, prewarm_seconds)."""
+
+    def obs(kind, **fields):
+        if kind == "profile":
+            events.append(fields["event"])
+
+    res, t_tune = _wall(lambda: at.resolve(
+        mode, path, observer=obs, lanes=args.tune_lanes, reps=args.reps,
+    ))
+    _, t_prewarm = _wall(
+        lambda: at.aot_prewarm(res.config, lanes=(args.tune_lanes,))
+    )
+    return res, t_tune, t_prewarm
+
+
+def _static_burst(at, msm: bool, lanes: int, reps: int) -> float:
+    """Dispatch seconds for the recombine burst under a PINNED msm
+    choice (the A/B the tuner's decision is judged against)."""
+    import dataclasses
+
+    dataclasses.replace(at.KernelConfig(), msm=msm).apply()
+    run = at.CANDIDATES["msm"].builder(lanes)
+    run()  # compile + first dispatch outside the timed region
+    return min(at._timed(run) for _ in range(max(1, reps)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CI shapes: tune lanes 4, burst 8, all gates on")
+    p.add_argument("--tune-lanes", type=int, default=None,
+                   help="micro-bench lane count (default: smoke 4 else 8)")
+    p.add_argument("--reps", type=int, default=3,
+                   help="timed reps per candidate value (min taken)")
+    p.add_argument("--burst-lanes", type=int, default=None,
+                   help="static A/B burst shape (default: smoke 8 else 256)")
+    p.add_argument("--assert-warm-frac", type=float, default=0.10,
+                   help="warm wall must be under this fraction of cold "
+                        "(0 disables)")
+    p.add_argument("--warm-frac-scope", choices=("tune", "boot"),
+                   default=None,
+                   help="what the warm fraction covers: 'tune' = "
+                        "resolve only (smoke default — trace-bound CPU "
+                        "geometry), 'boot' = tune + prewarm (default "
+                        "otherwise — accelerator geometries where "
+                        "compile dominates trace)")
+    p.add_argument("--assert-burst-tol", type=float, default=0.10,
+                   help="tuned choice may exceed the WORST static config "
+                        "by at most this fraction (negative disables)")
+    p.add_argument("--profile", default=None,
+                   help="profile path (default: throwaway temp dir)")
+    args = p.parse_args(argv)
+    if args.tune_lanes is None:
+        args.tune_lanes = 4 if args.smoke else 8
+    if args.burst_lanes is None:
+        args.burst_lanes = 8 if args.smoke else 256
+    if args.warm_frac_scope is None:
+        args.warm_frac_scope = "tune" if args.smoke else "boot"
+
+    import jax
+
+    from charon_tpu import jaxcache
+    from charon_tpu.core import autotune as at
+
+    jaxcache.configure(jax, cpu=jax.default_backend() == "cpu")
+
+    tmp = None
+    if args.profile:
+        path = Path(args.profile)
+    else:
+        tmp = tempfile.TemporaryDirectory(prefix="charon-autotune-bench-")
+        path = Path(tmp.name) / at.PROFILE_BASENAME
+
+    failures: list[str] = []
+    report: dict = {"smoke": args.smoke, "tune_lanes": args.tune_lanes,
+                    "burst_lanes": args.burst_lanes}
+
+    # -- COLD ----------------------------------------------------------
+    cold_events: list[str] = []
+    cold, t_cold_tune, t_cold_pre = _boot(at, "force", path, args,
+                                          cold_events)
+    t_cold = t_cold_tune + t_cold_pre
+    report["cold"] = {
+        "tune_seconds": round(t_cold_tune, 3),
+        "prewarm_seconds": round(t_cold_pre, 3),
+        "seconds": round(t_cold, 3),
+        "outcome": cold.outcome,
+        "bench_runs": cold.bench_runs,
+        "config": cold.config.as_dict(),
+        "timings": cold.timings,
+    }
+    print(f"# cold boot: tune {t_cold_tune:.1f} s + prewarm "
+          f"{t_cold_pre:.1f} s, outcome {cold.outcome}, "
+          f"{cold.bench_runs} bench runs, config {cold.config.as_dict()}")
+    if cold.outcome != "tuned" or cold.bench_runs == 0:
+        failures.append(
+            f"cold boot did not micro-bench (outcome {cold.outcome}, "
+            f"{cold.bench_runs} runs)")
+
+    # -- tuned-not-worst burst A/B ------------------------------------
+    def burst_ab():
+        timings = {
+            lbl: _static_burst(at, flag, args.burst_lanes, args.reps)
+            for lbl, flag in (("on", True), ("off", False))
+        }
+        tuned_lbl = at._label(cold.config.msm)
+        worst = max(timings.values())
+        return timings, tuned_lbl, timings[tuned_lbl], worst
+
+    timings, tuned_lbl, tuned_t, worst_t = burst_ab()
+    tol = args.assert_burst_tol
+    if tol >= 0 and tuned_t > worst_t * (1 + tol):
+        print(f"# tuned choice msm={tuned_lbl} {tuned_t:.3f} s vs worst "
+              f"{worst_t:.3f} s — remeasuring")
+        timings, tuned_lbl, tuned_t, worst_t = burst_ab()
+    report["burst"] = {
+        "lanes": args.burst_lanes,
+        "static_seconds": {k: round(v, 4) for k, v in timings.items()},
+        "tuned_choice": tuned_lbl,
+    }
+    print(f"# burst A/B @ {args.burst_lanes} lanes: "
+          f"{ {k: round(v, 3) for k, v in timings.items()} } — tuner "
+          f"picked msm={tuned_lbl}")
+    if tol >= 0 and tuned_t > worst_t * (1 + tol):
+        failures.append(
+            f"tuned choice msm={tuned_lbl} ({tuned_t:.3f} s) slower than "
+            f"worst static ({worst_t:.3f} s) beyond {tol:.0%}")
+
+    # -- WARM ----------------------------------------------------------
+    stats0 = jaxcache.cache_stats() or {}
+    scope = args.warm_frac_scope
+    cold_scoped = t_cold_tune if scope == "tune" else t_cold
+
+    def warm_once():
+        ev: list[str] = []
+        res, t_tune, t_pre = _boot(at, "auto", path, args, ev)
+        stats = jaxcache.cache_stats() or {}
+        grew = stats.get("entries", 0) - stats0.get("entries", 0)
+        return res, t_tune, t_pre, ev, grew
+
+    warm, tw_tune, tw_pre, warm_events, grew = warm_once()
+    frac = args.assert_warm_frac
+
+    def warm_scoped(t_tune, t_pre):
+        return t_tune if scope == "tune" else t_tune + t_pre
+
+    def warm_ok(res, t_tune, t_pre, g):
+        if res.outcome != "hit" or res.bench_runs != 0 or g > 0:
+            return False
+        return not frac or warm_scoped(t_tune, t_pre) < frac * cold_scoped
+
+    if not warm_ok(warm, tw_tune, tw_pre, grew):
+        print(f"# warm boot tune {tw_tune:.3f} s + prewarm {tw_pre:.1f} s "
+              f"(cold {cold_scoped:.1f} s at scope={scope}), outcome "
+              f"{warm.outcome}, +{grew} cache entries — remeasuring")
+        warm, tw_tune, tw_pre, warm_events, grew = warm_once()
+    t_warm = warm_scoped(tw_tune, tw_pre)
+    ratio = t_warm / max(cold_scoped, 1e-9)
+    report["warm"] = {
+        "tune_seconds": round(tw_tune, 4),
+        "prewarm_seconds": round(tw_pre, 3),
+        "outcome": warm.outcome,
+        "bench_runs": warm.bench_runs,
+        "new_cache_entries": grew,
+        "frac_scope": scope,
+        "frac_of_cold": round(ratio, 6),
+    }
+    print(f"# warm boot: tune {tw_tune:.3f} s + prewarm {tw_pre:.1f} s; "
+          f"{scope} scope {t_warm:.3f} s = {ratio:.2%} of cold "
+          f"{cold_scoped:.1f} s; outcome {warm.outcome}, "
+          f"{warm.bench_runs} bench runs, +{grew} cache entries")
+    if warm.outcome != "hit" or warm.bench_runs != 0:
+        failures.append(
+            f"warm boot was not a pure profile load (outcome "
+            f"{warm.outcome}, {warm.bench_runs} bench runs)")
+    if grew > 0:
+        failures.append(
+            f"warm boot wrote {grew} new compile-cache entries — prewarm "
+            f"recompiled instead of replaying artifacts")
+    if frac and t_warm >= frac * cold_scoped:
+        failures.append(
+            f"warm {scope} wall {t_warm:.3f} s is {ratio:.1%} of cold "
+            f"{cold_scoped:.1f} s (gate: < {frac:.0%})")
+
+    # -- digest invalidation ------------------------------------------
+    prof = at.load_profile(path)
+    prof["source_digest"] = "tampered-" + "0" * 8
+    at.save_profile(prof, path)
+    stale_events: list[str] = []
+
+    def obs(kind, **fields):
+        if kind == "profile":
+            stale_events.append(fields["event"])
+
+    retuned = at.resolve("auto", path, observer=obs,
+                         lanes=args.tune_lanes, reps=1)
+    report["digest_invalidation"] = {
+        "outcome": retuned.outcome,
+        "bench_runs": retuned.bench_runs,
+        "events": stale_events,
+    }
+    print(f"# digest tamper: outcome {retuned.outcome}, "
+          f"{retuned.bench_runs} bench runs, events {stale_events}")
+    if (retuned.outcome != "tuned" or retuned.bench_runs == 0
+            or "stale" not in stale_events):
+        failures.append(
+            f"source-digest tamper did not force a re-tune (outcome "
+            f"{retuned.outcome}, events {stale_events})")
+
+    # leave the process on kernel defaults, not the last trial's pins
+    at.KernelConfig().apply()
+    if tmp is not None:
+        tmp.cleanup()
+
+    report["cache"] = jaxcache.cache_stats() or {}
+    report["failures"] = failures
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("# all autotune gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

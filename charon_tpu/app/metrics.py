@@ -432,6 +432,70 @@ class ClusterMetrics:
             "(slow-duty detector over span ends)",
             ["duty"],
         )
+        # kernel auto-tuner + AOT compile-artifact cache (ISSUE 18):
+        # profile lifecycle, per-axis decisions and micro-bench
+        # timings from core/autotune.resolve, plus persistent
+        # compile-cache effectiveness from jaxcache.cache_stats —
+        # cold-start regressions show up here instead of in a
+        # 6-minute boot
+        self.autotune_profile_events = counter(
+            "tpu_autotune_profile_events_total",
+            "Kernel-profile lifecycle events from the startup tuner "
+            "(hit, miss, stale, corrupt, rebuilt, off, skipped)",
+            ["event"],
+        )
+        self.autotune_decisions = counter(
+            "tpu_autotune_decisions_total",
+            "Kernel-routing decisions applied at startup, per tunable "
+            "axis, with the choice and where it came from (profile, "
+            "tuned, env, default, inapplicable)",
+            ["axis", "choice", "source"],
+        )
+        self.autotune_bench_seconds = Histogram(
+            "tpu_autotune_bench_seconds",
+            "Per-candidate micro-bench dispatch time measured by the "
+            "startup tuner (best of its reps)",
+            labels + ["axis", "choice"],
+            registry=self.registry,
+            buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
+        )
+        self.autotune_prewarm_seconds = Histogram(
+            "tpu_autotune_prewarm_seconds",
+            "Ahead-of-time compile/load time per prewarm shape for the "
+            "chosen kernel variants (cold = real XLA compile, warm = "
+            "persistent-cache load)",
+            labels + ["axis"],
+            registry=self.registry,
+            buckets=(0.01, 0.05, 0.2, 1.0, 5.0, 30.0, 120.0, 600.0),
+        )
+        self.compile_cache_hits = Gauge(
+            "tpu_compile_cache_hits",
+            "Persistent XLA compile-cache hits since process start "
+            "(jaxcache monitoring listener; polled at scrape)",
+            labels,
+            registry=self.registry,
+        )
+        self.compile_cache_misses = Gauge(
+            "tpu_compile_cache_misses",
+            "Persistent XLA compile-cache misses (cache-consulting "
+            "compile requests minus hits) since process start",
+            labels,
+            registry=self.registry,
+        )
+        self.compile_cache_entries = Gauge(
+            "tpu_compile_cache_entries",
+            "Artifact files in this process's persistent compile-cache "
+            "dir (tuner profile excluded)",
+            labels,
+            registry=self.registry,
+        )
+        self.compile_cache_bytes = Gauge(
+            "tpu_compile_cache_bytes",
+            "Bytes on disk in this process's persistent compile-cache "
+            "dir (tuner profile excluded)",
+            labels,
+            registry=self.registry,
+        )
 
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)
@@ -556,6 +620,47 @@ class ClusterMetrics:
 
         return hook
 
+    def autotune_hook(self):
+        """core/autotune.resolve observer sink: typed tuner events ->
+        the autotune metric families. Runs on the tuner's worker
+        thread; prometheus client objects are thread-safe."""
+
+        def hook(kind: str, **f) -> None:
+            if kind == "profile":
+                self.labels(self.autotune_profile_events, f["event"]).inc()
+            elif kind == "decision":
+                self.labels(
+                    self.autotune_decisions,
+                    f["axis"],
+                    f["choice"],
+                    f["source"],
+                ).inc()
+            elif kind == "bench":
+                self.labels(
+                    self.autotune_bench_seconds, f["axis"], f["choice"]
+                ).observe(max(0.0, f["seconds"]))
+            elif kind == "prewarm":
+                self.labels(
+                    self.autotune_prewarm_seconds, f["axis"]
+                ).observe(max(0.0, f["seconds"]))
+
+        return hook
+
+    def observe_compile_cache(self) -> None:
+        """Refresh the persistent compile-cache gauges from
+        jaxcache.cache_stats (jax stays out of the scrape path —
+        jaxcache imports only stdlib; stats are None until
+        jaxcache.configure ran in this process)."""
+        from charon_tpu import jaxcache
+
+        stats = jaxcache.cache_stats()
+        if stats is None:
+            return
+        self.labels(self.compile_cache_hits).set(stats["hits"])
+        self.labels(self.compile_cache_misses).set(stats["misses"])
+        self.labels(self.compile_cache_entries).set(stats["entries"])
+        self.labels(self.compile_cache_bytes).set(stats["bytes"])
+
     def byzantine_hook(self):
         """core/evidence.EvidenceRegistry hook: one increment per
         attributed Byzantine detection, labelled by the offending peer
@@ -577,6 +682,7 @@ class ClusterMetrics:
 
     def render(self) -> bytes:
         self.observe_point_caches()
+        self.observe_compile_cache()
         return generate_latest(self.registry)
 
 

@@ -81,9 +81,22 @@ class Config:
     # runs synchronously on the event loop — the pre-pipeline path)
     crypto_plane_decode_workers: int = 4
     # startup compile of the canonical duty shapes: "auto" pre-warms
-    # only on a real accelerator backend (CPU test runs skip the
-    # minutes-long pairing compiles), "on" forces, "off" disables
+    # on a real accelerator backend OR when the kernel auto-tuner left
+    # a warm artifact story behind (valid tuned profile + non-empty
+    # persistent compile cache — prewarm then costs cache loads, not
+    # minutes-long compiles); "on" forces, "off" disables
     crypto_plane_prewarm: str = "auto"
+    # startup kernel auto-tune (core/autotune, ISSUE 18): "auto" loads
+    # the persisted per-platform profile (or micro-benches + persists
+    # one on first boot) and degrades to KernelConfig defaults on any
+    # failure; "on" is auto but refuses hosts without the device
+    # stack; "force" always re-benches; "off" applies defaults + the
+    # deprecated CHARON_* env overrides only
+    crypto_autotune: str = "auto"
+    # persisted kernel-profile path; "" = next to the jit cache
+    # (jaxcache.py placement rules: host-fingerprinted CPU dirs, one
+    # shared TPU dir)
+    crypto_autotune_profile: str = ""
     # bulk point-cache warm-up at startup (ISSUE 6): decode every
     # cluster pubshare/group key through the batched device kernels so
     # the first live slot starts at a warm cache instead of paying a
@@ -260,6 +273,17 @@ async def build_node(config: Config) -> Node:
 
         tbls.set_implementation(
             _resilient_ladder(TPUImpl(decode_mode=config.crypto_plane_decode))
+        )
+        # persistent compile-cache placement for the node process (the
+        # AOT artifact story — core/autotune + jaxcache): must be set
+        # before the first compilation; idempotent under test harnesses
+        # that already configured it (tests/conftest.py)
+        import jax as _jax_mod
+
+        from charon_tpu import jaxcache as _jaxcache
+
+        _jaxcache.configure(
+            _jax_mod, cpu=_jax_mod.default_backend() == "cpu"
         )
         if config.crypto_plane != "off":
             import jax
@@ -928,18 +952,92 @@ async def build_node(config: Config) -> Node:
 
     life.register_stop(Order.SCHEDULER, "scheduler", stop_sched)
 
+    # -- kernel auto-tune (core/autotune, ISSUE 18) -----------------------
+    # resolve the KernelConfig for this boot BEFORE the prewarm/warm-up
+    # hooks compile anything, so the duty programs compile under the
+    # TUNED routing (tune -> prewarm -> warm-up). Background task off
+    # the event loop; any failure degrades to defaults + env overrides
+    # and never blocks boot.
+    tune_done = asyncio.Event()
+    if config.use_tpu_tbls and config.crypto_autotune != "off":
+
+        async def autotune_start():
+            import time as _t
+
+            from charon_tpu.core import autotune as _autotune
+
+            t0 = _t.monotonic()
+            loop = asyncio.get_running_loop()
+            try:
+                result = await loop.run_in_executor(
+                    None,
+                    lambda: _autotune.resolve(
+                        config.crypto_autotune,
+                        config.crypto_autotune_profile or None,
+                        observer=metrics.autotune_hook(),
+                    ),
+                )
+                log.info(
+                    "kernel auto-tune resolved",
+                    topic="autotune",
+                    outcome=result.outcome,
+                    config=result.config.as_dict(),
+                    sources=result.sources,
+                    bench_runs=result.bench_runs,
+                    seconds=round(_t.monotonic() - t0, 1),
+                )
+            except Exception as e:  # noqa: BLE001 — background task:
+                # lifecycle gathers background exceptions silently, so
+                # a tuner failure must log here AND degrade to the
+                # proven defaults — kernel selection is a perf choice,
+                # never worth a failed boot
+                log.warn(
+                    "kernel auto-tune failed; running KernelConfig "
+                    "defaults",
+                    topic="autotune",
+                    err=f"{type(e).__name__}: {str(e)[:160]}",
+                    seconds=round(_t.monotonic() - t0, 1),
+                )
+                _autotune.apply_env()
+            finally:
+                tune_done.set()
+
+        life.register_start(
+            Order.MONITORING, "crypto-autotune", autotune_start
+        )
+    else:
+        tune_done.set()
+
     if crypto_plane is not None:
         prewarm = config.crypto_plane_prewarm
         if prewarm == "auto":
-            # pairing compiles take minutes on XLA:CPU — only a real
-            # accelerator backend amortizes the warmup
-            prewarm = "on" if jax.default_backend() == "tpu" else "off"
+            # pairing compiles take minutes on XLA:CPU — a real
+            # accelerator backend amortizes the warmup, and so does a
+            # warm artifact story (fresh tuned profile + non-empty
+            # persistent compile cache): prewarm then replays the
+            # compiles as cache loads (core/autotune.warm_boot_ready)
+            if jax.default_backend() == "tpu":
+                prewarm = "on"
+            else:
+                from charon_tpu.core import autotune as _at
+
+                prewarm = (
+                    "on"
+                    if config.crypto_autotune != "off"
+                    and _at.warm_boot_ready(
+                        config.crypto_autotune_profile or None
+                    )
+                    else "off"
+                )
         if prewarm == "on":
             # background: duties arriving mid-warmup queue behind the
             # compile on the serialized device lane instead of racing it
             async def prewarm_plane():
                 import time as _t
 
+                # compile under the TUNED kernel routing, not whatever
+                # defaults the tuner is about to replace
+                await tune_done.wait()
                 t0 = _t.monotonic()
                 try:
                     shapes = await crypto_plane.prewarm()
@@ -1035,6 +1133,9 @@ async def build_node(config: Config) -> Node:
             async def warm_point_caches_start():
                 import time as _t
 
+                # the decode kernels route through the tuned mont_mul
+                # dispatch — warm AFTER the tuner settled the flags
+                await tune_done.wait()
                 t0 = _t.monotonic()
                 try:
                     stats = await _warm_point_caches(

@@ -157,7 +157,9 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "on", "off"],
         default=_env_default("crypto-plane-prewarm", "") or "auto",
         help="compile the canonical duty shapes at startup: auto "
-        "pre-warms only on a TPU backend (CPU compiles take minutes)",
+        "pre-warms on a TPU backend, or on any platform once the "
+        "kernel auto-tuner left a fresh profile + warm compile cache "
+        "(cache loads, not minutes-long compiles)",
     )
     runp.add_argument(
         "--crypto-plane-decode",
@@ -177,6 +179,22 @@ def build_parser() -> argparse.ArgumentParser:
         "first live slot starts warm; auto warms only on a TPU "
         "backend (docs/operations.md 'Cold start and rotation "
         "warm-up')",
+    )
+    runp.add_argument(
+        "--crypto-autotune",
+        choices=["auto", "on", "off", "force"],
+        default=_env_default("crypto-autotune", "") or "auto",
+        help="startup kernel auto-tune (core/autotune.py): auto loads "
+        "the persisted per-platform profile or micro-benches + "
+        "persists one, on refuses hosts without the device stack, "
+        "force always re-benches, off applies KernelConfig defaults "
+        "(docs/operations.md 'Kernel auto-tuning and cold start')",
+    )
+    runp.add_argument(
+        "--crypto-autotune-profile",
+        default=_env_default("crypto-autotune-profile", ""),
+        help="kernel-profile path; default places it next to the "
+        "persistent jit cache for the detected platform (jaxcache.py)",
     )
     runp.add_argument(
         "--crypto-tenant",
@@ -578,6 +596,13 @@ def cmd_run(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.crypto_autotune not in ("auto", "on", "off", "force"):
+        print(
+            f"--crypto-autotune {args.crypto_autotune!r}: "
+            "must be auto, on, off, or force",
+            file=sys.stderr,
+        )
+        return 2
 
     rc = _init_featureset(args)
     if rc:
@@ -669,6 +694,8 @@ def cmd_run(args) -> int:
         crypto_plane_prewarm=args.crypto_plane_prewarm,
         crypto_plane_decode=args.crypto_plane_decode,
         crypto_plane_warmup=args.crypto_plane_warmup,
+        crypto_autotune=args.crypto_autotune,
+        crypto_autotune_profile=args.crypto_autotune_profile,
         crypto_tenant=args.crypto_tenant,
         crypto_tenant_weight=args.crypto_tenant_weight,
         crypto_tenant_queue_lanes=args.crypto_tenant_queue_lanes,

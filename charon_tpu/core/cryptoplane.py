@@ -173,8 +173,10 @@ def kernel_inventory() -> dict:
     shapes. Consumers: the jaxpr static analyzer
     (charon_tpu/analysis/jaxpr_check.py traces each family and gates
     its primitive census against tests/testdata/kernel_manifest.json)
-    and the future per-platform auto-tuner (ROADMAP item 3 enumerates
-    candidates from the same registry). Raises PlaneConfigError on a
+    and the per-platform startup auto-tuner (core/autotune.resolve
+    walks this registry before micro-benching its candidate axes and
+    records the family names in the persisted profile — ROADMAP item
+    3). Raises PlaneConfigError on a
     jax-less host (asking for the device inventory without jax is a
     deploy/config mistake) — inventory is an analysis/tuning surface,
     not a duty-path one."""

@@ -56,11 +56,64 @@ def cache_dir(cpu: bool) -> str:
     return _SHARED
 
 
+# Cache-effectiveness counters (ISSUE 18): jax emits a monitoring event
+# per compilation that consulted the persistent cache and one per hit;
+# misses = requests - hits. Registered once in configure(); the module
+# stays importable without jax so app/metrics.py can scrape
+# cache_stats() from any host process.
+_EVENTS = {"hits": 0, "requests": 0}
+_CONFIGURED_DIR: str | None = None
+
+
+def _on_event(event: str, **_kw) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _EVENTS["hits"] += 1
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        _EVENTS["requests"] += 1
+
+
 def configure(jax_mod, *, cpu: bool) -> str:
     """Point jax's persistent compilation cache at the right dir.
 
     Must run before any compilation; safe before backend init."""
+    global _CONFIGURED_DIR
     d = cache_dir(cpu)
     jax_mod.config.update("jax_compilation_cache_dir", d)
     jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    if _CONFIGURED_DIR is None:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_event)
+    _CONFIGURED_DIR = d
     return d
+
+
+def cache_stats() -> dict | None:
+    """Persistent-cache effectiveness for this process: entry count and
+    bytes on disk plus hit/miss counts since configure(). None until
+    configure() ran (host-only processes have no compile cache to
+    report — app/metrics.observe_compile_cache skips the gauges then).
+    """
+    if _CONFIGURED_DIR is None:
+        return None
+    entries = 0
+    nbytes = 0
+    try:
+        for root, _dirs, files in os.walk(_CONFIGURED_DIR):
+            for name in files:
+                if name.endswith(".json") or name.endswith(".tmp"):
+                    continue  # the tuner profile, not an XLA artifact
+                entries += 1
+                try:
+                    nbytes += os.stat(os.path.join(root, name)).st_size
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return {
+        "dir": _CONFIGURED_DIR,
+        "entries": entries,
+        "bytes": nbytes,
+        "hits": _EVENTS["hits"],
+        "misses": max(0, _EVENTS["requests"] - _EVENTS["hits"]),
+    }

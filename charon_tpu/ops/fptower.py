@@ -304,7 +304,8 @@ def fp2_batch(ctx, ops):
 # proven) mont_mul Pallas kernel active while the fp2 ops fall back to
 # the stacked-XLA path — bench.py's degradation ladder uses this so a
 # Mosaic regression in the fused kernels costs ~2x, not the ~10x of
-# losing Pallas entirely.
+# losing Pallas entirely. At startup the flag is owned by
+# core/autotune.KernelConfig (the fp2_fusion tuner axis).
 _FP2_FUSION = True
 
 

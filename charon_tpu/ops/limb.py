@@ -549,9 +549,12 @@ def _pallas_active(ctx: ModCtx) -> bool:
 
 
 # int8-MXU dispatch (ops/limb_mxu.py): opt-in until measured on real TPU
-# (set CHARON_MXU_MONT=1 or call set_mxu(True); bench.py exposes it as
-# BENCH_MXU=1). Takes precedence over the Pallas kernel when enabled so
-# the two lowerings can be A/B'd from the same bench invocation.
+# (call set_mxu(True); bench.py exposes it as BENCH_MXU=1, and the
+# startup tuner owns it via core/autotune.KernelConfig — the legacy
+# CHARON_MXU_MONT env toggle folds in there as an explicit override, so
+# this hot path no longer reads the environment). Takes precedence over
+# the Pallas kernel when enabled so the two lowerings can be A/B'd from
+# the same bench invocation.
 _MXU_MODE: bool | None = None
 
 
@@ -565,9 +568,7 @@ def _mxu_active(ctx: ModCtx) -> bool:
         return False
     if _MXU_MODE is not None:
         return _MXU_MODE
-    import os
-
-    return os.environ.get("CHARON_MXU_MONT") == "1"
+    return False
 
 
 def mont_mul(ctx: ModCtx, a, b):

@@ -46,8 +46,6 @@ ops/pairing.py batched_verify_grouped_rlc.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -246,8 +244,11 @@ _MSM_MODE: bool | None = None
 
 def set_msm(mode: bool | None) -> None:
     """Force the grouped-RLC randomization stage onto (True) / off (False)
-    the Pippenger kernel; None restores the default (env override
-    CHARON_MSM=0, else on)."""
+    the Pippenger kernel; None restores the default (on). Kernel choice
+    is owned by core/autotune.KernelConfig at startup — the legacy
+    CHARON_MSM env toggle is folded in there as an explicit override
+    (autotune.env_overrides); this hot path no longer reads the
+    environment."""
     global _MSM_MODE
     _MSM_MODE = mode
 
@@ -255,4 +256,4 @@ def set_msm(mode: bool | None) -> None:
 def msm_active() -> bool:
     if _MSM_MODE is not None:
         return _MSM_MODE
-    return os.environ.get("CHARON_MSM") != "0"
+    return True

@@ -412,8 +412,9 @@ def _fp2_call(ctx: ModCtx, kind: str, interpret: bool, mxu: bool = False):
 
 
 def _resolve_mxu(ctx: ModCtx, mxu: bool | None) -> bool:
-    """None = follow limb's MXU dispatch mode (CHARON_MXU_MONT /
-    limb.set_mxu); True/False = forced for this call."""
+    """None = follow limb's MXU dispatch mode (limb.set_mxu, owned at
+    startup by core/autotune.KernelConfig); True/False = forced for
+    this call."""
     if mxu is None:
         from charon_tpu.ops import limb as _limb
 

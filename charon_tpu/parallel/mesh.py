@@ -930,7 +930,13 @@ class SlotCryptoPlane:
         the attribution tier and the first forged lane mid-slot would
         still eat a cold compile). Shapes land on the same bucket
         ladder live flushes pad to, deduplicated per bucket. Returns
-        [(kind, bucket_lanes, seconds)] per compiled shape."""
+        [(kind, bucket_lanes, seconds)] per compiled shape.
+
+        app/run.py sequences this AFTER core/autotune.resolve so the
+        programs compile under the TUNED KernelConfig routing (and,
+        warm, replay as persistent-cache loads — the AOT artifact
+        story); the tuner's prewarm ladder (autotune.PREWARM_LANES)
+        deliberately matches these shapes."""
         import time as _time
 
         from charon_tpu.crypto.g1g2 import G1_GEN, G2_GEN

@@ -1,0 +1,322 @@
+"""Kernel auto-tuner tests (ISSUE 18): profile lifecycle — corrupt /
+truncated profiles degrade to a re-tune through typed errors, a
+source-digest mismatch provably re-tunes, forced env overrides outrank
+the tuned profile, hosts without the device stack skip loudly — plus
+the append-only profile-schema battery against the blessed golden
+(`tests/testdata/autotune_schema.json`) and the ops-layer guarantee
+that kernel routing no longer reads the environment.
+
+All resolve() calls inject a fake micro-bench: the real one compiles
+the recombine burst (minutes on XLA:CPU) and is exercised by
+bench_autotune.py, not here.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from charon_tpu.core import autotune
+from charon_tpu.core.cryptoplane import PlaneConfigError
+from charon_tpu.ops import fptower, limb
+from charon_tpu.ops import msm as MSM
+
+GOLDEN = Path(__file__).parent / "testdata" / "autotune_schema.json"
+
+
+@pytest.fixture(autouse=True)
+def _kernel_flags():
+    """conftest's _isolate_process_globals does NOT snapshot the ops
+    dispatch flags — restore the defaults after every test here."""
+    yield
+    MSM.set_msm(None)
+    limb.set_mxu(None)
+    limb.set_pallas(None)
+    fptower.set_fp2_fusion(True)
+
+
+def fake_bench(tuned_msm=True):
+    """micro_bench-compatible stand-in: no compiles, fixed verdicts."""
+
+    def bench(candidates=None, lanes=0, reps=0, base=None, observer=None):
+        choices = {
+            "msm": (tuned_msm, "tuned"),
+            "mxu_mont": (False, "inapplicable"),
+            "fp2_fusion": (True, "inapplicable"),
+        }
+        timings = {"msm": {"on": 0.5, "off": 2.0}}
+        return choices, timings, 2
+
+    return bench
+
+
+def events_of(log):
+    return [f["event"] for k, f in log if k == "profile"]
+
+
+def make_obs(log):
+    return lambda kind, **fields: log.append((kind, fields))
+
+
+# ---------------------------------------------------------------------------
+# Resolve lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_cold_tune_persists_then_pure_hit(tmp_path):
+    path = tmp_path / "profile.json"
+    log = []
+    res = autotune.resolve(
+        "auto", path, bench=fake_bench(), observer=make_obs(log)
+    )
+    assert res.outcome == "tuned"
+    assert res.bench_runs == 2
+    assert res.config.msm is True
+    assert path.exists()
+    assert events_of(log) == ["miss", "rebuilt"]
+
+    def explode(**kw):  # a hit must not micro-bench
+        raise AssertionError("bench ran on a warm boot")
+
+    log2 = []
+    res2 = autotune.resolve(
+        "auto", path, bench=explode, observer=make_obs(log2)
+    )
+    assert res2.outcome == "hit"
+    assert res2.bench_runs == 0
+    assert res2.config == res.config
+    assert events_of(log2) == ["hit"]
+    assert all(res2.sources[f] == "profile" for f in autotune.KernelConfig.TUNABLE)
+
+
+def test_force_retunes_over_fresh_profile(tmp_path):
+    path = tmp_path / "profile.json"
+    autotune.resolve("auto", path, bench=fake_bench())
+    res = autotune.resolve("force", path, bench=fake_bench(tuned_msm=False))
+    assert res.outcome == "tuned"
+    assert res.config.msm is False
+    assert autotune.load_profile(path)["config"]["msm"] is False
+
+
+def test_corrupt_profile_degrades_to_retune(tmp_path):
+    path = tmp_path / "profile.json"
+    path.write_text("{not json")
+    with pytest.raises(autotune.ProfileError) as exc:
+        autotune.load_profile(path)
+    assert exc.value.reason == "corrupt"
+    log = []
+    res = autotune.resolve(
+        "auto", path, bench=fake_bench(), observer=make_obs(log)
+    )
+    assert res.outcome == "tuned"
+    assert events_of(log) == ["corrupt", "rebuilt"]
+
+
+def test_truncated_profile_is_corrupt_not_crash(tmp_path):
+    path = tmp_path / "profile.json"
+    autotune.resolve("auto", path, bench=fake_bench())
+    full = path.read_text()
+    path.write_text(full[: len(full) // 2])
+    with pytest.raises(autotune.ProfileError) as exc:
+        autotune.load_profile(path)
+    assert exc.value.reason == "corrupt"
+    res = autotune.resolve("auto", path, bench=fake_bench())
+    assert res.outcome == "tuned"
+
+
+def test_schema_and_version_reasons(tmp_path):
+    path = tmp_path / "profile.json"
+    autotune.save_profile({"version": 1, "platform": "cpu"}, path)
+    with pytest.raises(autotune.ProfileError) as exc:
+        autotune.load_profile(path)
+    assert exc.value.reason == "schema"
+
+    prof = {
+        "version": autotune.PROFILE_VERSION + 1,
+        **autotune.fingerprint(),
+        "config": autotune.KernelConfig().as_dict(),
+    }
+    autotune.save_profile(prof, path)
+    with pytest.raises(autotune.ProfileError) as exc:
+        autotune.load_profile(path)
+    assert exc.value.reason == "version"
+
+    prof["version"] = autotune.PROFILE_VERSION
+    prof["config"] = {"msm": "yes"}
+    autotune.save_profile(prof, path)
+    with pytest.raises(autotune.ProfileError) as exc:
+        autotune.load_profile(path)
+    assert exc.value.reason == "schema"
+
+
+def test_digest_mismatch_triggers_retune(tmp_path):
+    path = tmp_path / "profile.json"
+    autotune.resolve("auto", path, bench=fake_bench())
+    prof = autotune.load_profile(path)
+    prof["source_digest"] = "not-the-blessed-digest"
+    autotune.save_profile(prof, path)
+    log = []
+    res = autotune.resolve(
+        "auto", path, bench=fake_bench(), observer=make_obs(log)
+    )
+    assert res.outcome == "tuned"
+    assert res.bench_runs > 0
+    assert events_of(log) == ["stale", "rebuilt"]
+    # the rewritten profile carries the CURRENT digest again
+    assert autotune.staleness(autotune.load_profile(path)) is None
+
+
+def test_env_override_outranks_profile(tmp_path):
+    path = tmp_path / "profile.json"
+    autotune.resolve("auto", path, bench=fake_bench(tuned_msm=True))
+    res = autotune.resolve(
+        "auto", path, bench=fake_bench(), environ={"CHARON_MSM": "0"}
+    )
+    assert res.outcome == "hit"
+    assert res.config.msm is False
+    assert res.sources["msm"] == "env"
+    assert res.sources["mxu_mont"] == "profile"
+    assert res.overrides == {"msm": False}
+    # the persisted profile keeps the TUNED verdict, not the pin
+    assert autotune.load_profile(path)["config"]["msm"] is True
+
+
+def test_mode_off_skips_profile_io(tmp_path):
+    path = tmp_path / "nonexistent" / "profile.json"
+    res = autotune.resolve("off", path, environ={"CHARON_MXU_MONT": "1"})
+    assert res.outcome == "off"
+    assert res.bench_runs == 0
+    assert res.config.mxu_mont is True
+    assert not path.parent.exists()
+
+
+def test_unknown_mode_is_typed(tmp_path):
+    with pytest.raises(PlaneConfigError):
+        autotune.resolve("bogus", tmp_path / "p.json")
+
+
+def test_host_without_device_stack(monkeypatch, tmp_path):
+    import charon_tpu.core.cryptoplane as cp
+
+    def no_stack():
+        raise PlaneConfigError("jax unavailable on this host")
+
+    monkeypatch.setattr(cp, "kernel_inventory", no_stack)
+    log = []
+    res = autotune.resolve(
+        "auto", tmp_path / "p.json", bench=fake_bench(),
+        observer=make_obs(log),
+    )
+    assert res.outcome == "skipped"
+    assert events_of(log) == ["skipped"]
+    with pytest.raises(PlaneConfigError):
+        autotune.resolve("on", tmp_path / "p.json", bench=fake_bench())
+    with pytest.raises(PlaneConfigError):
+        autotune.resolve("force", tmp_path / "p.json", bench=fake_bench())
+
+
+# ---------------------------------------------------------------------------
+# warm_boot_ready — the --crypto-plane-prewarm auto signal
+# ---------------------------------------------------------------------------
+
+
+def test_warm_boot_ready(monkeypatch, tmp_path):
+    from charon_tpu import jaxcache
+
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    monkeypatch.setattr(jaxcache, "cache_dir", lambda cpu: str(cache))
+    path = tmp_path / "profile.json"
+    assert autotune.warm_boot_ready(path) is False  # no profile
+
+    autotune.resolve("auto", path, bench=fake_bench())
+    assert autotune.warm_boot_ready(path) is False  # empty cache
+
+    (cache / "jit_program_0").write_bytes(b"\x00" * 16)
+    assert autotune.warm_boot_ready(path) is True
+
+    prof = autotune.load_profile(path)
+    prof["jax_version"] = "0.0.0"
+    autotune.save_profile(prof, path)
+    assert autotune.warm_boot_ready(path) is False  # stale profile
+
+
+# ---------------------------------------------------------------------------
+# Profile schema: golden sync + seeded-violation battery
+# ---------------------------------------------------------------------------
+
+
+def golden_schema():
+    return json.loads(GOLDEN.read_text())
+
+
+def test_schema_matches_golden():
+    assert autotune.compare_profile_schema(
+        golden_schema(), autotune.profile_schema()
+    ) == []
+
+
+def test_schema_field_removal_detected():
+    cur = autotune.profile_schema()
+    cur["fields"].remove("timings")
+    assert autotune.compare_profile_schema(golden_schema(), cur)
+
+
+def test_schema_field_reorder_detected():
+    cur = autotune.profile_schema()
+    cur["fields"][0], cur["fields"][1] = cur["fields"][1], cur["fields"][0]
+    assert autotune.compare_profile_schema(golden_schema(), cur)
+
+
+def test_schema_append_is_allowed():
+    cur = autotune.profile_schema()
+    cur["fields"].append("new_optional_field")
+    assert autotune.compare_profile_schema(golden_schema(), cur) == []
+
+
+def test_schema_new_required_needs_version_bump():
+    cur = autotune.profile_schema()
+    cur["fields"].append("new_field")
+    cur["required"].append("new_field")
+    assert autotune.compare_profile_schema(golden_schema(), cur)
+    cur["version"] += 1
+    assert autotune.compare_profile_schema(golden_schema(), cur) == []
+
+
+def test_schema_version_regression_detected():
+    cur = autotune.profile_schema()
+    cur["version"] = 0
+    assert autotune.compare_profile_schema(golden_schema(), cur)
+
+
+# ---------------------------------------------------------------------------
+# ops/ no longer reads the environment — KernelConfig owns routing
+# ---------------------------------------------------------------------------
+
+
+def test_msm_active_ignores_env(monkeypatch):
+    monkeypatch.setenv("CHARON_MSM", "0")
+    MSM.set_msm(None)
+    assert MSM.msm_active() is True  # env pin flows via resolve(), not ops
+
+
+def test_mxu_ignores_env(monkeypatch):
+    monkeypatch.setenv("CHARON_MXU_MONT", "1")
+    limb.set_mxu(None)
+    assert limb._mxu_active(limb.default_fp_ctx()) is False
+
+
+def test_env_overrides_parse():
+    env = {"CHARON_MSM": "0", "CHARON_MXU_MONT": "1"}
+    assert autotune.env_overrides(env) == {"msm": False, "mxu_mont": True}
+    assert autotune.env_overrides({}) == {}
+    cfg = autotune.apply_env(env)
+    assert cfg.msm is False and cfg.mxu_mont is True
+
+
+def test_kernel_config_apply_roundtrip():
+    cfg = autotune.KernelConfig(msm=False, mxu_mont=False, fp2_fusion=False)
+    assert cfg.apply() is True
+    assert MSM.msm_active() is False
+    autotune.KernelConfig().apply()
+    assert MSM.msm_active() is True
