@@ -82,8 +82,9 @@ class Config:
     crypto_plane_decode_workers: int = 4
     # startup compile of the canonical duty shapes: "auto" pre-warms
     # on a real accelerator backend OR when the kernel auto-tuner left
-    # a warm artifact story behind (valid tuned profile + non-empty
-    # persistent compile cache — prewarm then costs cache loads, not
+    # a warm artifact story behind (valid tuned profile + a prewarm
+    # that COMPLETED once under the same kernel sources, recorded by
+    # autotune.mark_prewarmed — prewarm then costs cache loads, not
     # minutes-long compiles); "on" forces, "off" disables
     crypto_plane_prewarm: str = "auto"
     # startup kernel auto-tune (core/autotune, ISSUE 18): "auto" loads
@@ -957,9 +958,13 @@ async def build_node(config: Config) -> Node:
     # hooks compile anything, so the duty programs compile under the
     # TUNED routing (tune -> prewarm -> warm-up). Background task off
     # the event loop; any failure degrades to defaults + env overrides
-    # and never blocks boot.
+    # and never blocks boot. Mode "off" flows through the SAME
+    # resolve() call: the ops/ hot paths no longer read the
+    # environment, so the deprecated CHARON_MSM/CHARON_MXU_MONT deploy
+    # pins only take effect if something applies them — "off" means
+    # defaults + env overrides, never silently-dropped pins.
     tune_done = asyncio.Event()
-    if config.use_tpu_tbls and config.crypto_autotune != "off":
+    if config.use_tpu_tbls:
 
         async def autotune_start():
             import time as _t
@@ -1009,13 +1014,23 @@ async def build_node(config: Config) -> Node:
         tune_done.set()
 
     if crypto_plane is not None:
+        # queue live flushes behind the boot-time tuner: micro_bench's
+        # trial.apply() flips the global dispatch flags and drops the
+        # jitted-kernel caches, so a duty flush racing the tuning
+        # window would compile under a transient trial config and
+        # immediately lose its executable (recompile churn + latency
+        # spikes exactly at boot). tune_done is set in the tuner
+        # hook's finally (or immediately when tbls is off), so the
+        # gate never wedges the plane.
+        crypto_plane.dispatch_gate = tune_done
         prewarm = config.crypto_plane_prewarm
         if prewarm == "auto":
             # pairing compiles take minutes on XLA:CPU — a real
             # accelerator backend amortizes the warmup, and so does a
-            # warm artifact story (fresh tuned profile + non-empty
-            # persistent compile cache): prewarm then replays the
-            # compiles as cache loads (core/autotune.warm_boot_ready)
+            # warm artifact story (fresh tuned profile + a prewarm
+            # that COMPLETED once under the same fingerprint): prewarm
+            # then replays the duty pairing compiles as cache loads
+            # (core/autotune.warm_boot_ready)
             if jax.default_backend() == "tpu":
                 prewarm = "on"
             else:
@@ -1060,6 +1075,24 @@ async def build_node(config: Config) -> Node:
                     shapes=[(k, n) for k, n, _ in shapes],
                     seconds=round(_t.monotonic() - t0, 1),
                 )
+                # the duty pairing programs are now in the persistent
+                # compile cache: record it so the NEXT boot's
+                # `--crypto-plane-prewarm auto` gate knows prewarm
+                # costs cache loads (autotune.warm_boot_ready)
+                try:
+                    from charon_tpu.core import autotune as _at2
+
+                    _at2.mark_prewarmed(
+                        config.crypto_autotune_profile or None
+                    )
+                except Exception as e:  # noqa: BLE001 — marker is an
+                    # optimization signal; losing it only means the
+                    # next auto boot stays conservative
+                    log.warn(
+                        "could not record prewarm completion marker",
+                        topic="app",
+                        err=f"{type(e).__name__}: {str(e)[:160]}",
+                    )
 
             life.register_start(
                 Order.MONITORING, "crypto-prewarm", prewarm_plane

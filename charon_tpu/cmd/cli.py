@@ -157,9 +157,11 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "on", "off"],
         default=_env_default("crypto-plane-prewarm", "") or "auto",
         help="compile the canonical duty shapes at startup: auto "
-        "pre-warms on a TPU backend, or on any platform once the "
-        "kernel auto-tuner left a fresh profile + warm compile cache "
-        "(cache loads, not minutes-long compiles)",
+        "pre-warms on a TPU backend, or on any platform once a "
+        "fresh tuned profile exists AND a prior prewarm completed "
+        "under the same kernel sources (cache loads, not "
+        "minutes-long compiles); the first off-TPU prewarm needs "
+        "one explicit 'on' boot",
     )
     runp.add_argument(
         "--crypto-plane-decode",
@@ -187,7 +189,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="startup kernel auto-tune (core/autotune.py): auto loads "
         "the persisted per-platform profile or micro-benches + "
         "persists one, on refuses hosts without the device stack, "
-        "force always re-benches, off applies KernelConfig defaults "
+        "force always re-benches, off applies KernelConfig defaults + "
+        "the deprecated CHARON_* env pins (no profile IO, no bench) "
         "(docs/operations.md 'Kernel auto-tuning and cold start')",
     )
     runp.add_argument(

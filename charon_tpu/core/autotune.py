@@ -60,6 +60,10 @@ PREWARM_LANES = (4, 16, 64, 256)
 
 PROFILE_VERSION = 1
 PROFILE_BASENAME = "autotune_profile.json"
+# written by mark_prewarmed() after a SUCCESSFUL crypto-plane prewarm;
+# warm_boot_ready requires it (a warm micro-bench cache alone does not
+# make the duty pairing programs cheap)
+PREWARM_MARKER_BASENAME = "prewarm_complete.json"
 # Append-only field ledger (mirrors analysis/schema_check.py): existing
 # fields never move or vanish, new fields append, and a NEW field may
 # only join PROFILE_REQUIRED together with a version bump. The blessed
@@ -555,12 +559,22 @@ def load_profile(path) -> dict:
 
 def save_profile(prof: dict, path) -> None:
     """Atomic write (tmp + rename) — a crash mid-save must leave either
-    the old profile or none, never a truncated one."""
+    the old profile or none, never a truncated one. The tmp name is
+    per-writer (pid): two nodes cold-booting against a shared cache dir
+    must not interleave write_text/os.replace on ONE tmp file and
+    publish a torn profile."""
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
-    tmp = p.with_name(p.name + ".tmp")
-    tmp.write_text(json.dumps(prof, indent=1, sort_keys=True) + "\n")
-    os.replace(tmp, p)
+    tmp = p.with_name(f"{p.name}.{os.getpid()}.tmp")
+    try:
+        tmp.write_text(json.dumps(prof, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        raise
 
 
 def staleness(prof: dict, fp: dict | None = None) -> str | None:
@@ -574,24 +588,52 @@ def staleness(prof: dict, fp: dict | None = None) -> str | None:
     return None
 
 
-def warm_boot_ready(path=None) -> bool:
-    """True when a fresh tuned profile AND a non-empty persistent
-    compile cache exist for this platform — the signal that makes
-    `--crypto-plane-prewarm auto` worthwhile off-TPU (app/run.py):
-    prewarm then costs cache loads, not compiles."""
+def prewarm_marker_path(path=None) -> Path:
+    """The prewarm-completion marker lives NEXT TO the profile (same
+    placement override rules), so wiping the cache dir wipes both."""
+    p = Path(path) if path else default_profile_path()
+    return p.with_name(PREWARM_MARKER_BASENAME)
+
+
+def mark_prewarmed(path=None) -> Path:
+    """Record that a crypto-plane prewarm COMPLETED under the current
+    fingerprint (app/run.py writes this after a successful
+    `crypto_plane.prewarm()`). This is the evidence `warm_boot_ready`
+    needs: a fresh tuned profile only proves the tuner's micro-bench
+    kernels are in the compile cache — the minutes-long duty pairing
+    programs land there only once a real prewarm (or explicit
+    `--crypto-plane-prewarm on` boot) has run to completion."""
+    m = prewarm_marker_path(path)
+    save_profile({"version": PROFILE_VERSION, **fingerprint()}, m)
+    return m
+
+
+def _read_marker(m: Path) -> dict | None:
     try:
-        import jax
+        d = json.loads(m.read_text())
+    except (OSError, ValueError):
+        return None
+    return d if isinstance(d, dict) else None
 
-        from charon_tpu import jaxcache
 
+def warm_boot_ready(path=None) -> bool:
+    """True when a fresh tuned profile AND a same-fingerprint prewarm
+    marker exist — the signal that makes `--crypto-plane-prewarm auto`
+    worthwhile off-TPU (app/run.py): prewarm then replays the duty
+    pairing programs as cache loads, not compiles. A non-empty cache
+    dir is NOT enough: after a first tuned boot it holds only the
+    tuner's micro-bench/prewarm kernels, and flipping prewarm on would
+    pay the full XLA:CPU pairing compiles the auto gate exists to
+    avoid. The marker is written only after a prewarm actually
+    completed (mark_prewarmed), and a platform/jax/source-digest change
+    distrusts it exactly like the profile."""
+    try:
+        fp = fingerprint()
         p = Path(path) if path else default_profile_path()
-        if staleness(load_profile(p)) is not None:
+        if staleness(load_profile(p), fp) is not None:
             return False
-        d = Path(jaxcache.cache_dir(jax.default_backend() == "cpu"))
-        return any(
-            e.is_file() and e.name != PROFILE_BASENAME
-            for e in d.iterdir()
-        )
+        mark = _read_marker(prewarm_marker_path(path))
+        return mark is not None and staleness(mark, fp) is None
     except (ImportError, ProfileError, OSError):
         return False
 

@@ -337,6 +337,15 @@ class SlotCoalescer:
         # submissions mid-decode (closing windows wait for these)
         self._decode_tickets: set[asyncio.Future] = set()
         self._window_current = window
+        # first-dispatch gate (app/run.py wires the autotune tune_done
+        # event here): the boot-time tuner's trial.apply() flips the
+        # global dispatch flags and drops the jitted-kernel caches, so
+        # a flush racing the tuning window compiles under a transient
+        # trial config and immediately loses its executable. Flushes
+        # queue behind the gate (and keep coalescing) until it fires;
+        # None (tests, CLI tools, no tuner) means no gating at all.
+        self.dispatch_gate: asyncio.Event | None = None
+        self.gated_flushes = 0  # flushes that waited on dispatch_gate
         # single-threaded device lane: a second window can elapse while a
         # device program is still running; its flush must QUEUE behind
         # the first, not race it (device contention + counter integrity)
@@ -678,6 +687,14 @@ class SlotCoalescer:
                 )
             except asyncio.TimeoutError:
                 pass
+        gate = self.dispatch_gate
+        if gate is not None and not gate.is_set():
+            # startup tuner still settling the kernel dispatch flags:
+            # queue this flush behind it. Waiting BEFORE the snapshot
+            # also lets submissions arriving during the tuning window
+            # coalesce into this flush instead of arming more of them.
+            self.gated_flushes += 1
+            await gate.wait()
         # submissions still mid-decode when the window closed join this
         # flush (ONE snapshot — later arrivals take the next window, so
         # sustained load cannot defer the flush unboundedly)

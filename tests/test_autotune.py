@@ -220,25 +220,70 @@ def test_host_without_device_stack(monkeypatch, tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_warm_boot_ready(monkeypatch, tmp_path):
-    from charon_tpu import jaxcache
-
-    cache = tmp_path / "cache"
-    cache.mkdir()
-    monkeypatch.setattr(jaxcache, "cache_dir", lambda cpu: str(cache))
+def test_warm_boot_ready(tmp_path):
     path = tmp_path / "profile.json"
     assert autotune.warm_boot_ready(path) is False  # no profile
 
     autotune.resolve("auto", path, bench=fake_bench())
-    assert autotune.warm_boot_ready(path) is False  # empty cache
+    # a fresh profile alone is NOT enough: only the tuner's micro-bench
+    # kernels are in the cache, not the duty pairing programs — flipping
+    # prewarm on here would pay the minutes-long XLA:CPU compiles the
+    # auto gate exists to avoid (REVIEW round 18)
+    assert autotune.warm_boot_ready(path) is False  # no prewarm marker
 
-    (cache / "jit_program_0").write_bytes(b"\x00" * 16)
+    marker = autotune.mark_prewarmed(path)
+    assert marker == autotune.prewarm_marker_path(path)
+    assert marker.parent == path.parent
     assert autotune.warm_boot_ready(path) is True
 
+    # a kernel-source change distrusts the marker exactly like the
+    # profile (the cached pairing programs no longer match the code)
+    mark = json.loads(marker.read_text())
+    mark["source_digest"] = "doctored"
+    autotune.save_profile(mark, marker)
+    assert autotune.warm_boot_ready(path) is False  # stale marker
+
+    autotune.mark_prewarmed(path)
+    assert autotune.warm_boot_ready(path) is True
     prof = autotune.load_profile(path)
     prof["jax_version"] = "0.0.0"
     autotune.save_profile(prof, path)
     assert autotune.warm_boot_ready(path) is False  # stale profile
+
+
+def test_warm_boot_ready_corrupt_marker(tmp_path):
+    path = tmp_path / "profile.json"
+    autotune.resolve("auto", path, bench=fake_bench())
+    autotune.prewarm_marker_path(path).write_text("{garbage")
+    assert autotune.warm_boot_ready(path) is False
+
+
+# ---------------------------------------------------------------------------
+# Profile persistence: per-writer atomic writes
+# ---------------------------------------------------------------------------
+
+
+def test_save_profile_tmp_is_per_writer_and_cleaned(monkeypatch, tmp_path):
+    import os
+
+    path = tmp_path / "profile.json"
+    autotune.save_profile({"version": 1}, path)
+    assert list(tmp_path.glob("*.tmp")) == []  # success leaves no tmp
+
+    # the tmp name carries the writer's pid: two nodes cold-booting
+    # against one shared cache dir must not interleave write/replace on
+    # a single tmp file and publish a torn profile
+    seen = {}
+
+    def fail_replace(src, dst):
+        seen["src"] = str(src)
+        raise OSError("disk full")
+
+    monkeypatch.setattr(autotune.os, "replace", fail_replace)
+    with pytest.raises(OSError):
+        autotune.save_profile({"version": 1}, path)
+    assert f".{os.getpid()}.tmp" in seen["src"]
+    assert list(tmp_path.glob("*.tmp")) == []  # failure unlinks its tmp
 
 
 # ---------------------------------------------------------------------------

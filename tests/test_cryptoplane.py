@@ -344,3 +344,52 @@ def test_host_bug_errors_do_not_burn_the_msm_rung():
         assert MSM.msm_active(), "host bug must not flip the MSM family"
     finally:
         MSM.set_msm(None)
+
+
+def test_dispatch_gate_queues_flush_until_tuner_settles():
+    """app/run.py wires the autotune tune_done event in as
+    dispatch_gate: a flush whose window closes while the boot-time
+    tuner is still flipping the kernel dispatch flags must QUEUE behind
+    the gate (and keep coalescing late arrivals) instead of racing the
+    trial configs and churning freshly compiled executables."""
+    impl = PythonImpl()
+    fake = FakePlane(T)
+    plane = SlotCoalescer(fake, window=0.01)
+
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    root = b"\x88" * 32
+    sig = impl.sign(sk, root)
+
+    async def main():
+        gate = asyncio.Event()
+        plane.dispatch_gate = gate
+        t1 = asyncio.create_task(plane.verify([(pk, root, sig)]))
+        await asyncio.sleep(0.05)  # window long elapsed, gate still down
+        assert fake.verify_calls == 0, "flush must wait for the tuner"
+        assert not t1.done()
+        # a submission arriving during the gated window joins the SAME
+        # armed flush rather than arming another one behind it
+        t2 = asyncio.create_task(plane.verify([(pk, root, sig)]))
+        await asyncio.sleep(0.02)
+        gate.set()
+        return await asyncio.gather(t1, t2)
+
+    r1, r2 = asyncio.run(main())
+    assert r1 == [True] and r2 == [True]
+    assert plane.gated_flushes == 1
+    assert fake.verify_calls == 1, "gated submissions share one program"
+    assert fake.verify_lane_count == 2
+
+
+def test_no_dispatch_gate_means_no_gating():
+    """Coalescers without a wired gate (tests, CLI tools, tbls off)
+    flush exactly as before."""
+    impl = PythonImpl()
+    fake = FakePlane(T)
+    plane = SlotCoalescer(fake, window=0.01)
+    sk = impl.generate_secret_key()
+    pk = impl.secret_to_public_key(sk)
+    sig = impl.sign(sk, b"\x99" * 32)
+    assert asyncio.run(plane.verify([(pk, b"\x99" * 32, sig)])) == [True]
+    assert plane.gated_flushes == 0
