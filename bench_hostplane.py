@@ -48,6 +48,14 @@ over-budget work actually sheds (PlaneOverloadError fail-fast) and
 --assert-tenant-ratio (default 2x) of its unflooded baseline — the
 jax-free isolation gate ci.sh's chaos/hostplane tiers ride.
 
+Observability overhead A/B (ISSUE 19): `--profiler` measures mean
+verify latency with the flight recorder + plane profiler chained on
+the coalescer's stats_hook path vs the bare coalescer at --lanes
+lanes, and FAILS unless the instrumented run stays within
+--assert-profiler-ratio (default 1.05x — the "within 5%" acceptance)
+AND the profiler's per-family seconds account for the device's busy
+time within 10%. `--smoke` includes the gate.
+
 `--smoke` (ci.sh fast tier) runs tiny shapes and FAILS (exit 1) when
 the stall improvement ratio drops below --assert-ratio or the overlap
 hits zero — the event-loop-stall regression guard.
@@ -485,6 +493,106 @@ async def tenants_ab(args) -> tuple[dict, bool]:
     return report, ok
 
 
+async def _profiler_phase(items, duties: int, device_s: float,
+                          instrumented: bool):
+    """Mean submit->result latency for `duties` verify bursts through
+    the coalescer — with or without the full ISSUE 19 observability
+    chain (flight recorder stats hook + plane profiler) on the
+    stats_hook path. Returns the profiler's per-family attribution and
+    the device's true busy seconds for the accounting gate."""
+    from charon_tpu.core.cryptoplane import SlotCoalescer
+
+    _clear_decode_caches()
+    plane = SimPlane(t=3, device_s=device_s)
+    rec = prof = None
+    hook = None
+    if instrumented:
+        from charon_tpu.app.flightrec import FlightRecorder, stats_hook
+        from charon_tpu.app.planeprof import PlaneProfiler
+
+        rec = FlightRecorder(node="bench")
+        prof = PlaneProfiler()
+        hook = stats_hook(rec, inner=prof.stats_hook())
+    coal = SlotCoalescer(
+        plane, window=0.01, decode_workers=2, decode_mode="device",
+        stats_hook=hook,
+    )
+    latencies: list[float] = []
+    try:
+        for i in range(duties + 3):
+            t0 = time.monotonic()
+            res = await coal.verify(list(items))
+            if i >= 3:  # first duties pay cold point-cache decodes
+                latencies.append(time.monotonic() - t0)
+            assert all(res)
+    finally:
+        coal.close()
+    out = {
+        "mean_seconds": round(sum(latencies) / len(latencies), 4),
+        "max_seconds": round(max(latencies), 4),
+        "device_busy_seconds": round(
+            sum(e - s for s, e in plane.spans), 4
+        ),
+    }
+    if instrumented:
+        out["family_seconds"] = round(sum(prof.kernel_seconds.values()), 4)
+        out["profiled_flushes"] = prof.flushes
+        out["recorded_events"] = len(rec)
+    return out
+
+
+async def profiler_ab(args) -> tuple[dict, bool]:
+    """Observability overhead gate (ISSUE 19): the always-on flight
+    recorder + plane profiler must hold mean burst latency within
+    --assert-profiler-ratio of the bare coalescer, AND the profiler's
+    per-family seconds must account for the device's busy time within
+    10% (remeasured before a verdict — CI-noise discipline)."""
+    items = make_burst(args.lanes)
+    duties = 12 if args.smoke else 20
+
+    async def measure():
+        bare = await _profiler_phase(items, duties, 0.02, False)
+        inst = await _profiler_phase(items, duties, 0.02, True)
+        ratio = inst["mean_seconds"] / max(bare["mean_seconds"], 1e-6)
+        return bare, inst, ratio
+
+    bare, inst, ratio = await measure()
+    want = args.assert_profiler_ratio
+    attempts = 1
+    while want and ratio >= want and attempts < 3:
+        print(f"# profiler overhead {ratio:.3f}x (want < {want}x) — "
+              f"remeasuring (attempt {attempts + 1}/3)")
+        bare, inst, ratio = await measure()
+        attempts += 1
+    # accounting: SimPlane has no program hook, so every flush lands on
+    # the synthetic 'device' family — the per-family sum must equal the
+    # device's true busy seconds within 10%
+    busy = inst["device_busy_seconds"]
+    acct_err = abs(inst["family_seconds"] - busy) / max(busy, 1e-9)
+    ok = (
+        (not want or ratio < want)
+        and acct_err <= 0.10
+        and inst["profiled_flushes"] > 0
+        and inst["recorded_events"] >= inst["profiled_flushes"]
+    )
+    report = {
+        "lanes": len(items),
+        "bare": bare,
+        "instrumented": inst,
+        "overhead_ratio": round(ratio, 3),
+        "family_accounting_error": round(acct_err, 4),
+        "measure_attempts": attempts,
+    }
+    print(
+        f"# profiler overhead: mean {bare['mean_seconds'] * 1000:.1f} ms "
+        f"bare -> {inst['mean_seconds'] * 1000:.1f} ms instrumented "
+        f"({ratio:.3f}x, want < {want}x); per-family seconds "
+        f"{inst['family_seconds']:.3f}s vs device busy {busy:.3f}s "
+        f"({acct_err * 100:.1f}% error, want <= 10%)"
+    )
+    return report, ok
+
+
 async def _remote_phase(items, duties: int, device_s: float,
                         remote: bool):
     """Mean submit->result latency for `duties` verify bursts through
@@ -590,6 +698,23 @@ async def remote_ab(args) -> tuple[dict, bool]:
 
 
 async def main(args) -> int:
+    if args.profiler:
+        # standalone observability overhead gate (ISSUE 19): jax-free,
+        # SimPlane device, flight recorder + plane profiler on the
+        # stats-hook path
+        report, ok = await profiler_ab(args)
+        print(json.dumps({"bench": "hostplane-profiler", **report},
+                         indent=2))
+        if not ok:
+            print(
+                f"FAIL: recorder+profiler overhead "
+                f"{report['overhead_ratio']}x (want < "
+                f"{args.assert_profiler_ratio}x) or family accounting "
+                f"error {report['family_accounting_error']} > 0.10"
+            )
+            return 1
+        print("profiler PASS")
+        return 0
     if args.remote:
         # remote crypto-plane dispatch overhead gate (ISSUE 17):
         # jax-free, SimPlane device, real sockets on localhost
@@ -694,6 +819,12 @@ async def main(args) -> int:
     h2c_ab, h2c_ok = None, True
     if args.smoke or args.cold_start:
         h2c_ab, h2c_ok = _run_h2c_gate(lanes, args.assert_h2c_ratio)
+    # observability overhead gate (ISSUE 19): under --smoke the flight
+    # recorder + profiler chain must stay within its latency budget and
+    # account for the device's busy seconds
+    prof_report, prof_ok = None, True
+    if args.smoke:
+        prof_report, prof_ok = await profiler_ab(args)
     report = {
         "bench": "hostplane",
         "smoke": args.smoke,
@@ -703,6 +834,7 @@ async def main(args) -> int:
         "measure_attempts": attempts,
         "decode_ab": ab,
         **({"h2c_cold_ab": h2c_ab} if h2c_ab else {}),
+        **({"profiler_ab": prof_report} if prof_report else {}),
     }
     print(json.dumps(report, indent=2))
     print(
@@ -728,6 +860,14 @@ async def main(args) -> int:
         print(
             f"FAIL: device h2c path cut cold-burst host CPU only "
             f"{h2c_ab['h2c_host_cpu_ratio']}x < {args.assert_h2c_ratio}x"
+        )
+        return 1
+    if not prof_ok:
+        print(
+            f"FAIL: recorder+profiler overhead "
+            f"{prof_report['overhead_ratio']}x (want < "
+            f"{args.assert_profiler_ratio}x) or family accounting "
+            f"error {prof_report['family_accounting_error']} > 0.10"
         )
         return 1
     if want:
@@ -802,4 +942,16 @@ if __name__ == "__main__":
     ap.add_argument("--assert-remote-ratio", type=float, default=2.0,
                     help="with --remote: fail unless the socket path "
                     "stays below this multiple of in-process dispatch")
+    ap.add_argument("--profiler", action="store_true",
+                    help="observability overhead A/B (ISSUE 19): mean "
+                    "verify latency with the flight recorder + plane "
+                    "profiler on the stats-hook path vs the bare "
+                    "coalescer at --lanes lanes; also asserts the "
+                    "profiler's per-family seconds account for the "
+                    "device busy time within 10%%")
+    ap.add_argument("--assert-profiler-ratio", type=float, default=1.05,
+                    help="with --profiler or --smoke: fail unless the "
+                    "instrumented mean latency stays below this "
+                    "multiple of the bare coalescer (ISSUE 19 "
+                    "acceptance: within 5%%)")
     raise SystemExit(asyncio.run(main(ap.parse_args())))

@@ -136,6 +136,11 @@ _WIRE_METHODS = frozenset(
 _METRIC_METHODS = frozenset({"labels"})
 _SPAN_METHODS = frozenset({"set_attr", "set_attrs", "span"})
 _KEYSTORE_METHODS = frozenset({"store_keys", "write_text", "write_bytes"})
+# flight-recorder intake (app/flightrec.FlightRecorder.record and the
+# hook adapters): events are dumped to disk and served at /debug/flight,
+# so a tainted value reaching record() is an exfiltration path even
+# though the sanitizer reduces structured objects to type names
+_RECORD_METHODS = frozenset({"record"})
 
 
 def _call_name(func: ast.AST, mod: LintModule) -> str | None:
@@ -559,4 +564,11 @@ class SecretFlow(Rule):
                     self.name, mod.relpath, node.lineno,
                     f"secret-tainted value written via .{attr}() "
                     "(keystore I/O must carry an audited pragma)",
+                )
+            elif attr in _RECORD_METHODS:
+                yield Violation(
+                    self.name, mod.relpath, node.lineno,
+                    "secret-tainted value recorded into the flight "
+                    "recorder (events are dumped to disk and served "
+                    "at /debug/flight)",
                 )

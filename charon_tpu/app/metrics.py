@@ -496,6 +496,81 @@ class ClusterMetrics:
             labels,
             registry=self.registry,
         )
+        # flight recorder + plane profiler + duty SLO engine (ISSUE 19):
+        # the post-mortem spine's own telemetry — ring intake/eviction,
+        # dump triggers, per-kernel-family device time, device duty
+        # cycle, per-tenant device attribution, and the rolling
+        # error-budget burn state
+        self.flightrec_events = counter(
+            "flightrec_events_total",
+            "Events recorded into the flight-recorder ring, by category",
+            ["category"],
+        )
+        self.flightrec_dropped = Gauge(
+            "flightrec_dropped_events",
+            "Events evicted from a full flight-recorder category ring "
+            "(cumulative; the recorder owns the counter state)",
+            labels + ["category"],
+            registry=self.registry,
+        )
+        self.flightrec_dumps = Gauge(
+            "flightrec_dumps",
+            "Flight-recorder JSONL dumps written, by trigger (demand, "
+            "sigterm, crash, stop; cumulative — recorder-owned state)",
+            labels + ["trigger"],
+            registry=self.registry,
+        )
+        self.plane_kernel_seconds = counter(
+            "tpu_plane_kernel_seconds_total",
+            "Device-dispatch wall seconds by mesh kernel family "
+            "(mesh/verify_rlc, mesh/step, ... per kernel_inventory; "
+            "'device' = plane without program hooks), sampled by the "
+            "plane profiler from SlotCryptoPlane.on_program",
+            ["family"],
+        )
+        self.plane_device_utilization = Gauge(
+            "tpu_plane_device_utilization",
+            "Device duty cycle: flush device_span seconds over the "
+            "profiler's rolling window, 0..1",
+            labels,
+            registry=self.registry,
+        )
+        self.plane_tenant_device_seconds = Counter(
+            "tpu_plane_tenant_device_seconds_total",
+            "Flush device_span seconds attributed to each tenant by "
+            "its live-lane share (FlushStats.tenant_lanes)",
+            labels + ["tenant"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "core_slo_burn_rate",
+            "Error-budget burn rate by objective (duty_miss, "
+            "step_latency), tenant, and alert window (fast, slow); "
+            "1.0 spends the budget exactly at the allowed pace",
+            labels + ["slo", "tenant", "window"],
+            registry=self.registry,
+        )
+        self.slo_budget_remaining = Gauge(
+            "core_slo_budget_remaining",
+            "Fraction of the slow-window error budget still unspent, "
+            "by objective and tenant (0..1)",
+            labels + ["slo", "tenant"],
+            registry=self.registry,
+        )
+        self.slo_alerts = Counter(
+            "core_slo_alerts_total",
+            "Burn-rate alert rising edges by objective, tenant, and "
+            "severity (critical gates /readyz via the health checker)",
+            labels + ["slo", "tenant", "severity"],
+            registry=self.registry,
+        )
+        self.stack_colocated = Gauge(
+            "stack_colocated_processes",
+            "Co-located validator-stack processes found on this host "
+            "by the stacksnipe /proc scan, by binary name",
+            labels + ["binary"],
+            registry=self.registry,
+        )
 
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)
@@ -680,6 +755,84 @@ class ClusterMetrics:
 
         return hook
 
+    def flightrec_hook(self):
+        """app/flightrec.FlightRecorder observer: one increment per
+        recorded event, by category. Runs on whatever thread recorded
+        the event; prometheus client objects are thread-safe."""
+
+        def hook(category: str, kind: str) -> None:
+            self.labels(self.flightrec_events, category).inc()
+
+        return hook
+
+    def observe_flightrec(self, rec) -> None:
+        """Refresh the recorder-owned cumulative state (eviction and
+        dump counts) into the flightrec gauges — same polled-gauge
+        pattern as the point caches."""
+        for category, n in rec.dropped_total.items():
+            if n:
+                self.labels(self.flightrec_dropped, category).set(n)
+        for trigger, n in rec.dumps_total.items():
+            self.labels(self.flightrec_dumps, trigger).set(n)
+
+    def profiler_hooks(self):
+        """app/planeprof.PlaneProfiler callbacks -> the kernel-family /
+        tenant-attribution / duty-cycle families. All run on the device
+        worker thread; prometheus client objects are thread-safe."""
+
+        def on_sample(family: str, seconds: float) -> None:
+            self.labels(self.plane_kernel_seconds, family).inc(
+                max(0.0, seconds)
+            )
+
+        def on_tenant(tenant: str, seconds: float) -> None:
+            self.labels(self.plane_tenant_device_seconds, tenant).inc(
+                max(0.0, seconds)
+            )
+
+        def on_utilization(fraction: float) -> None:
+            self.labels(self.plane_device_utilization).set(fraction)
+
+        return on_sample, on_tenant, on_utilization
+
+    def observe_slo(self, rows) -> None:
+        """Export one SLOEngine.evaluate() pass into the core_slo_*
+        gauges (run.py's health sample loop cadence)."""
+        for r in rows:
+            self.labels(
+                self.slo_burn_rate, r["slo"], r["tenant"], "fast"
+            ).set(r["fast_burn"])
+            self.labels(
+                self.slo_burn_rate, r["slo"], r["tenant"], "slow"
+            ).set(r["slow_burn"])
+            self.labels(
+                self.slo_budget_remaining, r["slo"], r["tenant"]
+            ).set(r["budget_remaining"])
+
+    def slo_alert_hook(self):
+        """SLOEngine.on_alert sink: count burn-rate alert rising edges."""
+
+        def hook(slo: str, tenant: str, severity: str) -> None:
+            self.labels(self.slo_alerts, slo, tenant, severity).inc()
+
+        return hook
+
+    def stacksnipe_hook(self):
+        """app/stacksnipe.StackSniper.on_report sink: publish the scan
+        as per-binary gauges, zeroing binaries that disappeared since
+        the previous scan."""
+        seen: set[str] = set()
+
+        def hook(report: dict) -> None:
+            for binary in seen - set(report):
+                self.labels(self.stack_colocated, binary).set(0)
+            for binary, pids in report.items():
+                self.labels(self.stack_colocated, binary).set(len(pids))
+            seen.clear()
+            seen.update(report)
+
+        return hook
+
     def render(self) -> bytes:
         self.observe_point_caches()
         self.observe_compile_cache()
@@ -830,11 +983,16 @@ async def serve_monitoring(
     ready_fn=None,
     consensus_dump=None,
     tracer=None,
+    flightrec=None,
+    profiler=None,
 ) -> asyncio.AbstractServer:
     """Minimal HTTP endpoint: /metrics, /livez, /readyz, /debug/traces,
     /debug/duty/<slot>, /debug/consensus (ref: app/monitoringapi.go:47;
-    docs/consensus.md:74 for the consensus debugger). `tracer` overrides
-    the process-global span store for the debug trace endpoints."""
+    docs/consensus.md:74 for the consensus debugger), /debug/flight
+    (ISSUE 19: the flight-recorder ring, filterable by category/tenant/
+    slot, ?format=text for the incident timeline, ?view=profile for the
+    plane profiler snapshot). `tracer` overrides the process-global span
+    store for the debug trace endpoints."""
 
     async def handle(reader, writer):
         try:
@@ -981,6 +1139,64 @@ async def serve_monitoring(
                     body = "\n".join(lines).encode()
                 ctype = b"text/plain"
                 status = b"200 OK"
+            elif path.startswith("/debug/flight"):
+                # the flight-recorder ring (ISSUE 19): newest-first-
+                # bounded JSON by default, ?format=text for the merged
+                # incident timeline, filters category/tenant/slot/limit,
+                # ?view=profile for the plane profiler's kernel-family
+                # decomposition. 404 when no recorder is wired (the
+                # endpoint must say so, not fake an empty incident).
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(path).query)
+
+                def one(name, conv=str):
+                    raw = (q.get(name) or [None])[0]
+                    if raw is None:
+                        return None
+                    try:
+                        return conv(raw)
+                    except ValueError:
+                        return None
+
+                if flightrec is None:
+                    body = b"flight recorder not enabled"
+                    ctype = b"text/plain"
+                    status = b"404 Not Found"
+                elif one("view") == "profile":
+                    if profiler is None:
+                        body = b"plane profiler not enabled"
+                        ctype = b"text/plain"
+                        status = b"404 Not Found"
+                    else:
+                        body = _json.dumps(profiler.snapshot()).encode()
+                        ctype = b"application/json"
+                        status = b"200 OK"
+                else:
+                    from charon_tpu.app import flightrec as _flightrec
+
+                    events = flightrec.events(
+                        category=one("category"),
+                        tenant=one("tenant"),
+                        slot=one("slot", int),
+                        limit=one("limit", int),
+                    )
+                    if one("format") == "text":
+                        body = _flightrec.render_timeline(events).encode()
+                        ctype = b"text/plain"
+                    else:
+                        body = _json.dumps(
+                            {
+                                "schema": _flightrec.SCHEMA_VERSION,
+                                "node": flightrec.node,
+                                "events": [
+                                    e.to_dict(node=flightrec.node)
+                                    for e in events
+                                ],
+                            }
+                        ).encode()
+                        ctype = b"application/json"
+                    status = b"200 OK"
             elif path.startswith("/debug/consensus"):
                 body = _json.dumps(
                     consensus_dump() if consensus_dump else []

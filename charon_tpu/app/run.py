@@ -150,6 +150,14 @@ class Config:
     crypto_serve_host: str = "127.0.0.1"
     # tenant_id -> auth token for dialing clusters (repr=False: secret)
     crypto_serve_tokens: dict = field(default_factory=dict, repr=False)
+    # flight recorder (ISSUE 19): always-on bounded per-category ring;
+    # dumps land in flight_dump_dir on SIGTERM / unhandled crash / clean
+    # stop for post-mortem merge (`charon-tpu flight merge`). 0 disables
+    # (harnesses that build many throwaway nodes).
+    flight_capacity: int = 512
+    flight_dump_dir: str = ""  # "" = <data_dir>/flightrec
+    # stack-sniping scan cadence (app/stacksnipe); 0 disables
+    stacksnipe_interval: float = 600.0
 
 
 @dataclass
@@ -173,6 +181,9 @@ class Node:
     crypto_remote_plane: object | None = None  # cryptosvc_client.RemotePlane
     crypto_server: object | None = None  # cryptosvc_server.CryptoServiceServer
     inclusion: InclusionChecker | None = None
+    flightrec: object | None = None  # app/flightrec.FlightRecorder
+    profiler: object | None = None  # app/planeprof.PlaneProfiler
+    slo: object | None = None  # app/health.SLOEngine
 
     async def rewarm_point_caches(
         self, pubkeys=(), messages=()
@@ -264,6 +275,13 @@ async def build_node(config: Config) -> Node:
     else:
         faultinject.init_from_env()
 
+    # plane profiler (ISSUE 19): constructed before the crypto plane so
+    # the plane factory can install its per-program timing hook; the
+    # metric callbacks attach once the catalogue exists below
+    from charon_tpu.app.planeprof import PlaneProfiler
+
+    profiler = PlaneProfiler()
+
     crypto_plane = None
     crypto_svc = None
     tenant_plane = None  # the handle components hold (core/cryptosvc)
@@ -297,9 +315,13 @@ async def build_node(config: Config) -> Node:
                 from charon_tpu.core.cryptoplane import SlotCoalescer
                 from charon_tpu.parallel import SlotCryptoPlane, make_mesh
 
-                plane_factory = lambda: SlotCryptoPlane(  # noqa: E731
-                    make_mesh(jax.devices()), t=t
-                )
+                def plane_factory():
+                    p = SlotCryptoPlane(make_mesh(jax.devices()), t=t)
+                    # per-program timing feeds the kernel-family
+                    # decomposition of every flush's device_span
+                    p.on_program = profiler.program_hook()
+                    return p
+
                 crypto_plane = SlotCoalescer(
                     plane_factory(),
                     window=config.crypto_plane_window,
@@ -359,6 +381,38 @@ async def build_node(config: Config) -> Node:
         peer=f"node{config.node_index}",
     )
 
+    # -- flight recorder + SLO engine (ISSUE 19) --------------------------
+    # The recorder is the post-mortem spine: every observer chain below
+    # records into it FIRST, then forwards to the existing metrics hook.
+    from charon_tpu.app import flightrec as flightrec_mod
+    from charon_tpu.app.health import SLOEngine
+
+    flight = None
+    flight_dump_dir = (
+        Path(config.flight_dump_dir)
+        if config.flight_dump_dir
+        else data_dir / "flightrec"
+    )
+    if config.flight_capacity > 0:
+        flight = flightrec_mod.FlightRecorder(
+            capacity=config.flight_capacity,
+            node=f"node{config.node_index}",
+            observer=metrics.flightrec_hook(),
+        )
+        flight.record("lifecycle", "start", node_index=config.node_index)
+    (
+        profiler.on_sample,
+        profiler.on_tenant,
+        profiler.on_utilization,
+    ) = metrics.profiler_hooks()
+    # duty-miss + step-latency error budgets with multi-window burn-rate
+    # alerting; tenant identity matches the crypto-plane tenant so the
+    # SLO series line up with the plane attribution families
+    slo_tenant = config.crypto_tenant or lock.definition.name
+    slo = SLOEngine(on_alert=metrics.slo_alert_hook())
+    # sampled by the health loop into the plane health-check series
+    plane_health = {"quarantines": 0, "autotune_fallback": 0}
+
     # -- tracing ----------------------------------------------------------
     # installed BEFORE the workflow wires so every span — including those
     # recorded during component construction — lands in this node's
@@ -381,10 +435,19 @@ async def build_node(config: Config) -> Node:
     from charon_tpu.app.metrics import SlowDutyDetector, span_metrics
 
     slow_detector = SlowDutyDetector(metrics)
+
+    def _slo_span(span) -> None:
+        # every finished workflow-step span feeds the step-latency SLO
+        # (same series the step-latency histogram observes; shared
+        # plane-bridge copies are skipped for the same reason)
+        if span.attrs.get("shared"):
+            return
+        slo.observe_step(max(0.0, span.end - span.start), tenant=slo_tenant)
+
     # keep handles so shutdown can unhook: node_tracer may be the
     # process-global tracer (default build), and a later build_node in
     # the same process must not feed spans into THIS node's registry
-    _node_hooks = [span_metrics(metrics), slow_detector.observe]
+    _node_hooks = [span_metrics(metrics), slow_detector.observe, _slo_span]
     node_tracer.hooks.extend(_node_hooks)
     if crypto_plane is not None:
         # one rich per-flush stats hook (runs on the device worker
@@ -430,10 +493,16 @@ async def build_node(config: Config) -> Node:
 
         # bridge each flush's decode/pack/device stages into tracer
         # spans joined to the duty traces that rode the flush (ISSUE 4
-        # replaces cryptoplane's old trace=True tuples with this)
-        crypto_plane.stats_hook = tracer.plane_span_bridge(
-            node_tracer, inner_hook=_plane_stats
+        # replaces cryptoplane's old trace=True tuples with this);
+        # the profiler attributes the buffered per-program samples to
+        # this flush, and the flight recorder logs the flush summary —
+        # all on the serialized device worker thread
+        _stats_chain = profiler.stats_hook(
+            inner=tracer.plane_span_bridge(node_tracer, inner_hook=_plane_stats)
         )
+        if flight is not None:
+            _stats_chain = flightrec_mod.stats_hook(flight, inner=_stats_chain)
+        crypto_plane.stats_hook = _stats_chain
         # bulk warm-up passes (startup + rotation) land in the
         # cold-start metric families (ISSUE 6)
         crypto_plane.warmup_hook = metrics.observe_warmup
@@ -449,10 +518,13 @@ async def build_node(config: Config) -> Node:
         )
 
         tenant_id = config.crypto_tenant or lock.definition.name
+        tenant_obs = metrics.tenant_hook()
+        if flight is not None:
+            tenant_obs = flightrec_mod.tenant_hook(flight, inner=tenant_obs)
         crypto_svc = CryptoPlaneService(
             crypto_plane,
             round_lanes=config.crypto_plane_round_lanes,
-            observer=metrics.tenant_hook(),
+            observer=tenant_obs,
         )
         tenant_plane = crypto_svc.register(
             tenant_id,
@@ -482,13 +554,24 @@ async def build_node(config: Config) -> Node:
             from charon_tpu.core.cryptosvc_client import RemotePlane
 
             r_host, _, r_port = config.crypto_remote.rpartition(":")
+            remote_obs = metrics.remote_hook(tenant_id)
+            if flight is not None:
+                # addr names the dialed server in the ring: a merged
+                # post-mortem attributes a failover to the exact
+                # aborted endpoint
+                remote_obs = flightrec_mod.remote_hook(
+                    flight,
+                    tenant_id,
+                    addr=f"{r_host or '127.0.0.1'}:{int(r_port)}",
+                    inner=remote_obs,
+                )
             remote_plane = RemotePlane(
                 r_host or "127.0.0.1",
                 int(r_port),
                 tenant_id,
                 config.crypto_remote_token,
                 local=tenant_plane,
-                observer=metrics.remote_hook(tenant_id),
+                observer=remote_obs,
                 stats_hook=crypto_plane.stats_hook,
             )
             tenant_plane = remote_plane
@@ -513,6 +596,11 @@ async def build_node(config: Config) -> Node:
                 host=config.crypto_serve_host,
                 port=config.crypto_serve,
                 register_tenants=True,
+                observer=(
+                    flightrec_mod.server_hook(flight)
+                    if flight is not None
+                    else None
+                ),
             )
 
     # -- beacon client ----------------------------------------------------
@@ -622,8 +710,20 @@ async def build_node(config: Config) -> Node:
         # wire codec observability (ISSUE 7): per-frame encode/decode
         # seconds + byte volume by codec (binary vs json fallback)
         p2p_node.wire_observer = metrics.wire_hook()
-        # per-peer codec quarantine mutes (ISSUE 8 satellite)
-        p2p_node.quarantine_observer = metrics.peer_quarantine_hook()
+        # per-peer codec quarantine mutes (ISSUE 8 satellite); counted
+        # for the peer_quarantine_active health check and recorded in
+        # the flight ring
+        _q_metrics = metrics.peer_quarantine_hook()
+
+        def _q_obs(peer_idx, mute_seconds):
+            plane_health["quarantines"] += 1
+            _q_metrics(peer_idx, mute_seconds)
+
+        p2p_node.quarantine_observer = (
+            flightrec_mod.quarantine_hook(flight, inner=_q_obs)
+            if flight is not None
+            else _q_obs
+        )
         await p2p_node.start()
         # frame-level faults on the live mesh (inert no-op by default)
         faultinject.maybe_wrap_p2p_node(p2p_node)
@@ -657,7 +757,12 @@ async def build_node(config: Config) -> Node:
     # evidence excludes the peer's lanes from sigagg recombination.
     from charon_tpu.core.evidence import EvidenceRegistry
 
-    evidence = EvidenceRegistry(hook=metrics.byzantine_hook())
+    byz_hook = metrics.byzantine_hook()
+    if flight is not None:
+        # the flightrec adapter takes the 3-arg form: the registry
+        # passes the free-text detail through to the ring
+        byz_hook = flightrec_mod.byzantine_hook(flight, inner=byz_hook)
+    evidence = EvidenceRegistry(hook=byz_hook)
     dutydb = DutyDB()
     parsigdb = ParSigDB(threshold=t, evidence=evidence)
     sigagg = SigAgg(
@@ -705,6 +810,10 @@ async def build_node(config: Config) -> Node:
         ).set(s["duration"])
 
     qbft_consensus.on_decided_stats = _consensus_stats
+    if flight is not None:
+        # round changes are the consensus-stall signature a post-mortem
+        # looks for first
+        qbft_consensus.on_round_change = flightrec_mod.consensus_hook(flight)
     vapi = ValidatorAPI(
         share_idx=share_idx,
         pubshares=pubshares_by_idx[share_idx],
@@ -787,6 +896,13 @@ async def build_node(config: Config) -> Node:
             )
 
     tracker.subscribe(_report_metrics)
+    if flight is not None:
+        tracker.subscribe(flightrec_mod.duty_hook(flight))
+
+    def _slo_duty(report):
+        slo.observe_duty(report.success, tenant=slo_tenant)
+
+    tracker.subscribe(_slo_duty)
 
     # deadliner trims stores + triggers tracker analysis; the slow-duty
     # detector settles each duty's traced wall time against its budget
@@ -973,14 +1089,24 @@ async def build_node(config: Config) -> Node:
 
             t0 = _t.monotonic()
             loop = asyncio.get_running_loop()
+            autotune_obs = metrics.autotune_hook()
+            if flight is not None:
+                autotune_obs = flightrec_mod.autotune_hook(
+                    flight, inner=autotune_obs
+                )
             try:
                 result = await loop.run_in_executor(
                     None,
                     lambda: _autotune.resolve(
                         config.crypto_autotune,
                         config.crypto_autotune_profile or None,
-                        observer=metrics.autotune_hook(),
+                        observer=autotune_obs,
                     ),
+                )
+                # "skipped" = the tuner refused/degraded to defaults —
+                # the autotune_defaults health check watches this
+                plane_health["autotune_fallback"] = (
+                    1 if result.outcome == "skipped" else 0
                 )
                 log.info(
                     "kernel auto-tune resolved",
@@ -1003,6 +1129,7 @@ async def build_node(config: Config) -> Node:
                     err=f"{type(e).__name__}: {str(e)[:160]}",
                     seconds=round(_t.monotonic() - t0, 1),
                 )
+                plane_health["autotune_fallback"] = 1
                 _autotune.apply_env()
             finally:
                 tune_done.set()
@@ -1200,12 +1327,25 @@ async def build_node(config: Config) -> Node:
     # health: the reference catalogue evaluated over this node's own
     # sampled metrics, gating /readyz (ref: app/health + monitoringapi)
     from charon_tpu.app import log as app_log
-    from charon_tpu.app.health import HealthChecker, Metadata, MetricStore
+    from charon_tpu.app.health import (
+        HealthChecker,
+        Metadata,
+        MetricStore,
+        default_checks,
+        plane_checks,
+    )
 
     health_store = MetricStore()
     health = HealthChecker(
         health_store,
-        metadata=Metadata(num_validators=len(lock.validators), quorum=t),
+        # reference catalogue + distributed-plane catalogue + the SLO
+        # engine's burn-rate gates (ISSUE 19)
+        checks=default_checks() + plane_checks() + slo.checks(),
+        metadata=Metadata(
+            num_validators=len(lock.validators),
+            quorum=t,
+            remote_plane=remote_plane is not None,
+        ),
     )
 
     async def _sample_health_loop(interval: float = 30.0):
@@ -1258,11 +1398,99 @@ async def build_node(config: Config) -> Node:
                     health_store.sample("app_beacon_syncing", 0)
                 except Exception:  # noqa: BLE001 — syncing or unreachable
                     health_store.sample("app_beacon_syncing", 1)
+                # distributed-plane catalogue series (ISSUE 19): the
+                # plane_checks() docstring documents each name
+                if crypto_svc is not None:
+                    _bstate = {"closed": 0, "half_open": 1, "open": 2}
+                    health_store.sample(
+                        "tpu_plane_tenant_breaker_state",
+                        max(
+                            (
+                                _bstate.get(ten.breaker.state, 0)
+                                for ten in crypto_svc._tenants.values()
+                            ),
+                            default=0,
+                        ),
+                    )
+                if remote_plane is not None:
+                    health_store.sample(
+                        "tpu_plane_remote_state",
+                        {"down": 0, "probing": 1, "up": 2}.get(
+                            remote_plane.state, 0
+                        ),
+                    )
+                health_store.sample(
+                    "wire_peer_quarantine_total",
+                    plane_health["quarantines"],
+                )
+                health_store.sample(
+                    "tpu_autotune_fallback",
+                    plane_health["autotune_fallback"],
+                )
+                # SLO burn gauges + recorder eviction/dump gauges ride
+                # the same cadence
+                metrics.observe_slo(slo.evaluate())
+                if flight is not None:
+                    metrics.observe_flightrec(flight)
             except Exception as e:  # noqa: BLE001 — sampling must not die
                 log.warn("health sampling failed", topic="app", err=str(e))
             await _asyncio.sleep(interval)
 
     life.register_start(Order.MONITORING, "health-sampler", _sample_health_loop)
+
+    # stack sniping (ISSUE 19 satellite): periodic /proc scan for
+    # co-located validator-stack processes -> stack_colocated_processes
+    # gauges + a lifecycle event in the flight ring
+    if config.stacksnipe_interval > 0:
+        from charon_tpu.app.stacksnipe import StackSniper
+
+        _snipe_metrics = metrics.stacksnipe_hook()
+
+        def _snipe_report(report):
+            _snipe_metrics(report)
+            if flight is not None and report:
+                flight.record(
+                    "lifecycle",
+                    "colocated",
+                    binaries=sorted(report),
+                    processes=sum(len(p) for p in report.values()),
+                )
+
+        sniper = StackSniper(
+            interval=config.stacksnipe_interval, on_report=_snipe_report
+        )
+        life.register_start(Order.MONITORING, "stacksnipe", sniper.run)
+
+    # flight-recorder egress (ISSUE 19): crash/SIGTERM handlers dump the
+    # ring; the stop hook dumps on clean shutdown and restores the
+    # previous handlers. TRACKER order (lowest) = the dump runs LAST, so
+    # events recorded during other components' teardown are captured.
+    if flight is not None:
+        flight_dump_dir.mkdir(parents=True, exist_ok=True)
+        _uninstall_crash = flightrec_mod.install_crash_handlers(
+            flight,
+            str(flight_dump_dir / f"node{config.node_index}.crash.jsonl"),
+        )
+
+        async def stop_flight():
+            flight.record("lifecycle", "stop")
+            try:
+                flight.dump_jsonl(
+                    str(
+                        flight_dump_dir
+                        / f"node{config.node_index}.stop.jsonl"
+                    ),
+                    trigger="stop",
+                )
+            except OSError as e:
+                log.warn(
+                    "flight-recorder stop dump failed",
+                    topic="app",
+                    err=str(e),
+                )
+            _uninstall_crash()
+
+        life.register_stop(Order.TRACKER, "flightrec", stop_flight)
 
     # exporter/JSONL built at the top of build_node (spans flow for the
     # node's whole life); flushed + closed at shutdown. Registered
@@ -1302,6 +1530,8 @@ async def build_node(config: Config) -> Node:
                 health_checker=health,
                 consensus_dump=consensus_dump,
                 tracer=node_tracer,
+                flightrec=flight,
+                profiler=profiler,
             )
 
         life.register_start(Order.MONITORING, "monitoring", start_mon, background=False)
@@ -1324,6 +1554,9 @@ async def build_node(config: Config) -> Node:
         crypto_remote_plane=remote_plane,
         crypto_server=crypto_server,
         inclusion=inclusion,
+        flightrec=flight,
+        profiler=profiler,
+        slo=slo,
     )
 
 

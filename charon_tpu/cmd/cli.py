@@ -459,6 +459,33 @@ def build_parser() -> argparse.ArgumentParser:
         "--beacon-url", default="", help="POST the exit to this beacon node"
     )
 
+    flightp = sub.add_parser(
+        "flight", help="flight-recorder post-mortem tools"
+    )
+    flightsub = flightp.add_subparsers(dest="flight_command", required=True)
+    fmerge = flightsub.add_parser(
+        "merge",
+        help="merge per-node flight dumps into one incident timeline",
+    )
+    fmerge.add_argument(
+        "dumps", nargs="+", help="per-node flight-recorder JSONL dumps"
+    )
+    fmerge.add_argument(
+        "--format",
+        choices=("text", "jsonl"),
+        default="text",
+        help="text timeline (default) or merged JSONL",
+    )
+    fmerge.add_argument(
+        "--category", default="", help="only events of this category"
+    )
+    fmerge.add_argument(
+        "--tenant", default="", help="only events for this tenant"
+    )
+    fmerge.add_argument(
+        "--output", default="", help="write here instead of stdout"
+    )
+
     relayp = sub.add_parser("relay", help="run a rendezvous relay server")
     relayp.add_argument("--port", type=int, default=3640)
     relayp.add_argument("--host", default="0.0.0.0")
@@ -1414,6 +1441,32 @@ def cmd_test(args) -> int:
     return run_coro(probe_http())
 
 
+def cmd_flight(args) -> int:
+    """Post-mortem tooling over flight-recorder dumps (app/flightrec):
+    merge per-node JSONL rings — dumped on SIGTERM/crash/stop or pulled
+    from /debug/flight — into one wall-clock-ordered incident timeline,
+    deduped by (node, seq)."""
+    import json as _json
+
+    from charon_tpu.app import flightrec
+
+    events = flightrec.merge_jsonl(args.dumps)
+    if args.category:
+        events = [e for e in events if e.get("category") == args.category]
+    if args.tenant:
+        events = [e for e in events if e.get("tenant") == args.tenant]
+    if args.format == "jsonl":
+        out = "".join(_json.dumps(e) + "\n" for e in events)
+    else:
+        out = flightrec.render_timeline(events)
+    if args.output:
+        Path(args.output).write_text(out, encoding="utf-8")
+        print(f"wrote {len(events)} events -> {args.output}")
+    else:
+        sys.stdout.write(out)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "version":
@@ -1431,6 +1484,7 @@ def main(argv=None) -> int:
         "enr": cmd_enr,
         "combine": cmd_combine,
         "exit": cmd_exit,
+        "flight": cmd_flight,
         "relay": cmd_relay,
         "alpha": cmd_alpha,
         "test": cmd_test,

@@ -190,6 +190,10 @@ class QBFTConsensus:
         # observer (run.py wires it into the metrics catalogue)
         self.last_decided: dict | None = None
         self.on_decided_stats = None
+        # flight-recorder edge (ISSUE 19): fired from the sniffer for
+        # every ROUND_CHANGE observed in either direction —
+        # on_round_change(duty, round, source, direction)
+        self.on_round_change = None
 
     def subscribe(self, sub: DecidedSub) -> None:
         self._subs.append(sub)
@@ -328,6 +332,12 @@ class QBFTConsensus:
                 "justification": len(msg.justification or ()),
             }
         )
+        mtype = getattr(msg.type, "name", str(msg.type))
+        if mtype == "ROUND_CHANGE" and self.on_round_change is not None:
+            try:
+                self.on_round_change(duty, msg.round, msg.source, direction)
+            except Exception:  # noqa: BLE001 — observability must not break delivery
+                pass
 
     def debug_dump(self) -> list[dict]:
         """Recent consensus messages, oldest first (served at

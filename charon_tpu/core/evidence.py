@@ -46,7 +46,28 @@ EXCLUSION_KINDS = frozenset(
     {QBFT_EQUIVOCATION, PARSIG_CONFLICT, PARSIG_SPOOF}
 )
 
-EvidenceHook = Callable[[object, str], None]
+# hook(peer, kind) or hook(peer, kind, detail) — the registry detects
+# the arity once at construction (ISSUE 19: the flight recorder wants
+# the free-text detail; the metrics counter hook never did, and every
+# existing 2-arg hook keeps working unchanged)
+EvidenceHook = Callable[..., None]
+
+
+def _accepts_detail(hook) -> bool:
+    import inspect
+
+    try:
+        params = list(inspect.signature(hook).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    positional = [
+        p
+        for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    if any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True
+    return len(positional) >= 3
 
 
 class EvidenceRegistry:
@@ -64,6 +85,7 @@ class EvidenceRegistry:
         self, hook: EvidenceHook | None = None, max_keys: int = 4096
     ) -> None:
         self._hook = hook
+        self._hook_detail = hook is not None and _accepts_detail(hook)
         self._max_keys = max_keys
         self._counts: dict[tuple[object, str], int] = {}
 
@@ -74,7 +96,10 @@ class EvidenceRegistry:
             return
         self._counts[key] = (n or 0) + 1
         if self._hook is not None:
-            self._hook(peer, kind)
+            if self._hook_detail:
+                self._hook(peer, kind, detail)
+            else:
+                self._hook(peer, kind)
 
     def count(self, peer: object = None, kind: str | None = None) -> int:
         return sum(

@@ -16,6 +16,7 @@ single compiled SPMD program.
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 import jax
@@ -123,6 +124,29 @@ class SlotCryptoPlane:
         # program feeds thousands of point-cache entries per dispatch.
         self._h2c = self._build_h2c()
         self._g1dec = self._build_g1dec()
+        # per-program timing hook (ISSUE 19): callable(family, seconds,
+        # lanes), family names matching kernel_families ("mesh/verify_rlc"
+        # ...). Fired from the host dispatch methods around each compiled
+        # program INCLUDING its result sync, so the per-family times sum
+        # to (approximately) the flush device_span — app/planeprof feeds
+        # tpu_plane_kernel_seconds from it. None (the default) costs one
+        # attribute check per dispatch.
+        self.on_program = None
+
+    def _timed(self, family: str, lanes: int, fn):
+        """Run one compiled-program dispatch (with its sync) under the
+        timing hook. Hook faults never fail the dispatch."""
+        hook = self.on_program
+        if hook is None:
+            return fn()
+        t0 = time.monotonic()
+        try:
+            return fn()
+        finally:
+            try:
+                hook(f"mesh/{family}", time.monotonic() - t0, lanes)
+            except Exception:  # noqa: BLE001 — observability stays off the duty path
+                pass
 
     def _step_body(self, pubshares, msg, partials, group_pk, indices, live):
         """Per-shard recombine + per-lane attribution verify. Shared by
@@ -533,11 +557,15 @@ class SlotCryptoPlane:
         lanes = lanes + [lanes[0]] * pad
         arrays = SSWU.pack_hashed(self.ctx, lanes)
         live = jnp.asarray(np.arange(n + pad) < n)
-        aff, valid = self._h2c(*arrays, live)
-        return (
-            C.g2_unpack(self.ctx, aff)[:n],
-            [bool(b) for b in np.asarray(valid)[:n]],
-        )
+
+        def run():
+            aff, valid = self._h2c(*arrays, live)
+            return (
+                C.g2_unpack(self.ctx, aff)[:n],
+                [bool(b) for b in np.asarray(valid)[:n]],
+            )
+
+        return self._timed("h2c", n, run)
 
     def decompress_g1_host(self, encoded):
         """Compressed 48-byte G1 lanes (or parsed lanes) -> ([affine
@@ -554,11 +582,15 @@ class SlotCryptoPlane:
         parsed = parsed + [parsed[0]] * pad
         x0, sign, inf, ok = DEC.pack_parsed_g1(self.ctx, parsed)
         live = jnp.asarray(np.arange(n + pad) < n)
-        aff, valid = self._g1dec(x0, sign, inf, ok, live)
-        return (
-            C.g1_unpack(self.ctx, aff)[:n],
-            [bool(b) for b in np.asarray(valid)[:n]],
-        )
+
+        def run():
+            aff, valid = self._g1dec(x0, sign, inf, ok, live)
+            return (
+                C.g1_unpack(self.ctx, aff)[:n],
+                [bool(b) for b in np.asarray(valid)[:n]],
+            )
+
+        return self._timed("g1dec", n, run)
 
     def pack_inputs(self, pubshares, msgs, partials, group_pks, indices):
         """Python-int affine points -> device arrays laid out [V, t]/[V].
@@ -670,13 +702,24 @@ class SlotCryptoPlane:
         fail decompression on device come back False; the RLC fast path's
         per-lane answer is exactly the decode mask."""
         pk, msg, sx0, sx1, sign, live = arrays
-        all_ok, lane_ok = self._verify_rlc_dec(
-            pk, msg, sx0, sx1, sign, live, rand
-        )
-        if bool(all_ok):
+
+        def fast():
+            all_ok, lane_ok = self._verify_rlc_dec(
+                pk, msg, sx0, sx1, sign, live, rand
+            )
+            return bool(all_ok), lane_ok
+
+        all_ok, lane_ok = self._timed("verify_rlc_dec", n, fast)
+        if all_ok:
             return [bool(b) for b in np.asarray(lane_ok)[:n]]
-        ok = self._verify_dec(pk, msg, sx0, sx1, sign, live)
-        return [bool(b) for b in np.asarray(ok)[:n]]
+        ok = self._timed(
+            "verify_dec",
+            n,
+            lambda: np.asarray(
+                self._verify_dec(pk, msg, sx0, sx1, sign, live)
+            ),
+        )
+        return [bool(b) for b in ok[:n]]
 
     def verify_packed(self, arrays, rand, n: int) -> list[bool]:
         """Device stage of verify_host on an already-packed batch — the
@@ -684,10 +727,18 @@ class SlotCryptoPlane:
         this from the serialized device lane, so host packing of window
         k overlaps device execution of window k-1."""
         pk, msg, sig, live = arrays
-        if bool(self._verify_rlc(pk, msg, sig, live, rand)):
+        if self._timed(
+            "verify_rlc",
+            n,
+            lambda: bool(self._verify_rlc(pk, msg, sig, live, rand)),
+        ):
             return [True] * n
-        ok = self._verify(pk, msg, sig, live)
-        return [bool(b) for b in np.asarray(ok)[:n]]
+        ok = self._timed(
+            "verify",
+            n,
+            lambda: np.asarray(self._verify(pk, msg, sig, live)),
+        )
+        return [bool(b) for b in ok[:n]]
 
     def verify_host(self, pks, msgs, sigs, rng=None) -> list[bool]:
         """Sharded batch verify of N independent (pk, msg, sig) lanes.
@@ -738,29 +789,49 @@ class SlotCryptoPlane:
         """Device stage for a parsed recombine batch. Rows with an
         undecodable partial recombine as identities (their group sig
         unpacks to None) and come back ok=False."""
-        group_sig, all_ok, row_ok = self._step_rlc_dec(*args, rand)
-        if bool(all_ok):
+        def fast():
+            group_sig, all_ok, row_ok = self._step_rlc_dec(*args, rand)
+            if not bool(all_ok):
+                return None
             return (
                 C.g2_unpack(self.ctx, group_sig)[:v],
                 [bool(b) for b in np.asarray(row_ok)[:v]],
             )
-        group_sig, ok, _total = self._step_dec(*args)
-        return (
-            C.g2_unpack(self.ctx, group_sig)[:v],
-            [bool(b) for b in np.asarray(ok)[:v]],
-        )
+
+        res = self._timed("step_rlc_dec", v, fast)
+        if res is not None:
+            return res
+
+        def attrib():
+            group_sig, ok, _total = self._step_dec(*args)
+            return (
+                C.g2_unpack(self.ctx, group_sig)[:v],
+                [bool(b) for b in np.asarray(ok)[:v]],
+            )
+
+        return self._timed("step_dec", v, attrib)
 
     def recombine_packed(self, args, rand, v: int):
         """Device stage of recombine_host on an already-packed [V, t]
         batch (see verify_packed for the pipelining contract)."""
-        group_sig, all_ok = self.step_rlc(*args, rand)
-        if bool(all_ok):
+        def fast():
+            group_sig, all_ok = self.step_rlc(*args, rand)
+            if not bool(all_ok):
+                return None
             return C.g2_unpack(self.ctx, group_sig)[:v], [True] * v
-        group_sig, ok, _total = self.step(*args)
-        return (
-            C.g2_unpack(self.ctx, group_sig)[:v],
-            [bool(b) for b in np.asarray(ok)[:v]],
-        )
+
+        res = self._timed("step_rlc", v, fast)
+        if res is not None:
+            return res
+
+        def attrib():
+            group_sig, ok, _total = self.step(*args)
+            return (
+                C.g2_unpack(self.ctx, group_sig)[:v],
+                [bool(b) for b in np.asarray(ok)[:v]],
+            )
+
+        return self._timed("step", v, attrib)
 
     def recombine_host(
         self, pubshares, msgs, partials, group_pks, indices, rng=None

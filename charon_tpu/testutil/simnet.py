@@ -99,6 +99,19 @@ class SimCluster:
             if node.tracer is not None and node.tracer.jsonl_path
         ]
 
+    def dump_flight(self, out_dir: str) -> list[str]:
+        """Dump every node's flight-recorder ring (flightrec=True
+        builds) into out_dir; returns the per-node dump paths, ready
+        for app/flightrec.merge_jsonl cross-node reconstruction."""
+        paths: list[str] = []
+        for node in self.nodes:
+            if node.flightrec is None:
+                continue
+            path = f"{out_dir}/node{node.share_idx}.flight.jsonl"
+            node.flightrec.dump_jsonl(path, trigger="demand")
+            paths.append(path)
+        return paths
+
 
 @dataclass
 class SimNode:
@@ -120,6 +133,9 @@ class SimNode:
     # core/evidence.EvidenceRegistry — per-node Byzantine detections,
     # same wiring as production (app/run.py)
     evidence: object | None = None
+    # app/flightrec.FlightRecorder — per-node post-mortem ring, same
+    # hook chains as production (flightrec=True builds)
+    flightrec: object | None = None
 
 
 class SimHostPlane:
@@ -185,6 +201,7 @@ def build_cluster(
     tracing_on: bool = False,
     trace_dir: str | None = None,
     crypto_plane: bool = False,
+    flightrec: bool = False,
 ) -> SimCluster:
     """Create keys and wire n in-process nodes (ref: app/app.go simnet +
     cluster/test_cluster.go generator, redesigned for asyncio).
@@ -201,7 +218,9 @@ def build_cluster(
     merge. `crypto_plane` routes inbound parsig verification through a
     SlotCoalescer over SimHostPlane so duty traces carry real
     decode/pack/device stage spans without jax; call cluster.close()
-    when done."""
+    when done. `flightrec` gives every node its own post-mortem ring
+    with the production hook chains (evidence, round changes, duty
+    outcomes, flush summaries); dump with cluster.dump_flight()."""
     impl = tbls.get_implementation()
 
     group_pubkeys: list[PubKey] = []
@@ -289,6 +308,7 @@ def build_cluster(
                 tracing_on=tracing_on,
                 trace_dir=trace_dir,
                 crypto_plane=crypto_plane,
+                flightrec=flightrec,
             )
         )
     return cluster
@@ -306,6 +326,7 @@ def _build_node(
     tracing_on: bool = False,
     trace_dir: str | None = None,
     crypto_plane: bool = False,
+    flightrec: bool = False,
 ) -> SimNode:
     beacon = cluster.beacon
     fork = cluster.fork
@@ -319,21 +340,32 @@ def _build_node(
         )
         node_tracer = Tracer(jsonl_path=jsonl)
 
+    rec = None
+    if flightrec:
+        from charon_tpu.app import flightrec as flightrec_mod
+
+        rec = flightrec_mod.FlightRecorder(node=f"node{share_idx}")
+
     plane = None
     if crypto_plane:
         from charon_tpu.app.tracer import plane_span_bridge
         from charon_tpu.core.cryptoplane import SlotCoalescer
 
+        plane_stats = plane_span_bridge(node_tracer)
+        if rec is not None:
+            plane_stats = flightrec_mod.stats_hook(rec, inner=plane_stats)
         plane = SlotCoalescer(
             SimHostPlane(cluster.t),
             window=0.005,
             decode_workers=2,
-            stats_hook=plane_span_bridge(node_tracer),
+            stats_hook=plane_stats,
         )
 
     from charon_tpu.core.evidence import EvidenceRegistry
 
-    evidence = EvidenceRegistry()
+    evidence = EvidenceRegistry(
+        hook=flightrec_mod.byzantine_hook(rec) if rec is not None else None
+    )
     dutydb = DutyDB()
     parsigdb = ParSigDB(threshold=cluster.t, evidence=evidence)
     sigagg = SigAgg(
@@ -349,16 +381,17 @@ def _build_node(
     if qbft_net is not None:
         from charon_tpu.core.consensus_qbft import QBFTConsensus
 
-        consensus = ConsensusController(
-            QBFTConsensus(
-                qbft_net,
-                cluster.n,
-                round_timeout=0.3,
-                timer="inc",
-                tracer=node_tracer,
-                evidence=evidence,
-            )
+        qc = QBFTConsensus(
+            qbft_net,
+            cluster.n,
+            round_timeout=0.3,
+            timer="inc",
+            tracer=node_tracer,
+            evidence=evidence,
         )
+        if rec is not None:
+            qc.on_round_change = flightrec_mod.consensus_hook(rec)
+        consensus = ConsensusController(qc)
         # echo stays registered as a switchable alternate so priority
         # negotiation can change the protocol mid-run
         consensus.register(EchoConsensus())
@@ -413,6 +446,8 @@ def _build_node(
         peer_share_indices=list(range(1, cluster.n + 1)),
         threshold=cluster.t,
     )
+    if rec is not None:
+        tracker.subscribe(flightrec_mod.duty_hook(rec))
 
     options = [tracking(tracker), spawn_fetch]
     if node_tracer is not None:
@@ -505,4 +540,5 @@ def _build_node(
         crypto_plane=plane,
         parsigex=parsigex,
         evidence=evidence,
+        flightrec=rec,
     )

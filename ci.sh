@@ -64,6 +64,9 @@ case "$TIER" in
     python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
+    # flight-recorder event schema (ISSUE 19): append-only golden —
+    # renaming/removing a category or kind breaks merged post-mortems
+    python -m charon_tpu.analysis.flightrec_check
     # device-graph gate (ISSUE 11): jaxpr invariants + kernel golden
     # manifest (sentinel families traced live, the rest digest-covered)
     python -m charon_tpu.analysis.jaxpr_check
@@ -97,6 +100,11 @@ case "$TIER" in
     python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
+    # flight-recorder event schema (ISSUE 19): append-only golden
+    # against tests/testdata/flightrec_schema.json (regenerate
+    # DELIBERATELY with `python -m charon_tpu.analysis.flightrec_check
+    # --update`)
+    python -m charon_tpu.analysis.flightrec_check
     # the jaxpr gate is the one analysis checker that NEEDS jax (it
     # traces the device graphs); on jax-less images skip it LOUDLY —
     # the jax-free gates above still ran
@@ -150,6 +158,7 @@ case "$TIER" in
     python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
+    python -m charon_tpu.analysis.flightrec_check
     # full tier retraces EVERY kernel family against the golden
     # manifest (25-60 s per pairing family — run when touching ops/)
     python -m charon_tpu.analysis.jaxpr_check --full
@@ -189,7 +198,10 @@ case "$TIER" in
     # partitions, corrupt frames, slow drips — every affected duty
     # degrades down the local tbls ladder (zero missed), reconnect
     # resumes remote serving, and failover/shed counters attribute
-    # every event to the right tenant.
+    # every event to the right tenant. Includes the flight-recorder
+    # post-mortem gate (ISSUE 19): the kill-mid-flush merged timeline
+    # must name the aborted server endpoint, the typed failover
+    # reason, and every affected tenant.
     "${PYTEST[@]}" tests/test_cryptosvc_chaos.py tests/test_cryptosvc_remote.py
     python bench_hostplane.py --tenants
     # remote dispatch overhead gate: the socket path (codec frames +

@@ -13,7 +13,12 @@ scrapes and asserts:
                         waterfall) for a completed duty, 404 for an
                         unknown slot;
   * per-node JSONL exports merge into ONE duty-rooted trace per duty
-    covering every wire edge plus cryptoplane decode/device stages.
+    covering every wire edge plus cryptoplane decode/device stages;
+  * /debug/flight    — the flight-recorder ring over HTTP (JSON + text
+                        timeline) and the core_slo_* burn-rate gauges
+                        on /metrics (ISSUE 19), then every node's
+                        flight dump merged into one wall-clock-ordered
+                        cross-node incident record.
 
 jax-free and CPU-safe (the device program is a wall-clock sleep), so
 it runs in the fast tier tail; exit 1 on any violated gate.
@@ -55,7 +60,8 @@ def _get(url: str) -> tuple[int, bytes]:
 
 async def main(args) -> int:
     from charon_tpu import tbls
-    from charon_tpu.app import tracer
+    from charon_tpu.app import flightrec, tracer
+    from charon_tpu.app.health import SLOEngine
     from charon_tpu.app.metrics import (
         ClusterMetrics,
         serve_monitoring,
@@ -88,14 +94,22 @@ async def main(args) -> int:
             tracing_on=True,
             trace_dir=trace_dir,
             crypto_plane=True,
+            flightrec=True,
         )
         # monitoring endpoint off node 1's tracer + a metrics registry
         # fed by its span ends — the same wiring app/run.py does
         metrics = ClusterMetrics("0xobs", "obs-check", "node0")
         node1 = cluster.nodes[0]
         node1.tracer.hooks.append(span_metrics(metrics))
+        # duty SLO engine fed from node 1's tracker reports (ISSUE 19),
+        # min_events=1 so a short run still produces rows
+        slo = SLOEngine(min_events=1, on_alert=metrics.slo_alert_hook())
+        node1.tracker.subscribe(
+            lambda rep: slo.observe_duty(rep.success, tenant="obs")
+        )
         server = await serve_monitoring(
-            "127.0.0.1", 0, metrics, tracer=node1.tracer
+            "127.0.0.1", 0, metrics, tracer=node1.tracer,
+            flightrec=node1.flightrec,
         )
         port = server.sockets[0].getsockname()[1]
         base = f"http://127.0.0.1:{port}"
@@ -122,6 +136,14 @@ async def main(args) -> int:
 
         slots = _completed_attester_slots(cluster.beacon, 4)[: args.duties]
         gate(len(slots) >= args.duties, f"{args.duties} duties completed")
+
+        # drive duty expiry (production's Deadliner job): the tracker
+        # only emits per-duty reports at expiry, and those reports feed
+        # the flight recorder's duty ring and the SLO engine (ISSUE 19)
+        for slot in slots:
+            duty = Duty(slot=slot, type=DutyType.ATTESTER)
+            for node in cluster.nodes:
+                await node.tracker.duty_expired(duty)
 
         # /metrics
         status, body = await asyncio.to_thread(_get, f"{base}/metrics")
@@ -169,9 +191,76 @@ async def main(args) -> int:
         except urllib.error.HTTPError as e:
             gate(e.code == 404, "/debug/duty/<unknown> 404s")
 
+        # core_slo_* families (ISSUE 19): evaluate the duty-miss budget
+        # over the completed run and scrape the exported gauges
+        metrics.observe_slo(slo.evaluate())
+        status, body = await asyncio.to_thread(_get, f"{base}/metrics")
+        text = body.decode()
+        gate(
+            "core_slo_burn_rate" in text and 'slo="duty_miss"' in text,
+            "/metrics carries core_slo_burn_rate{slo=duty_miss}",
+        )
+        gate(
+            "core_slo_budget_remaining" in text,
+            "/metrics carries core_slo_budget_remaining",
+        )
+        gate(
+            not slo.firing("duty_miss"),
+            "duty-miss SLO not burning after a clean run",
+        )
+
+        # /debug/flight (ISSUE 19): node 1's ring over HTTP
+        status, body = await asyncio.to_thread(_get, f"{base}/debug/flight")
+        doc = json.loads(body)
+        gate(
+            status == 200
+            and doc["schema"] == flightrec.SCHEMA_VERSION
+            and len(doc["events"]) > 0,
+            "/debug/flight serves the node's event ring",
+        )
+        categories = {e["category"] for e in doc["events"]}
+        gate(
+            {"flush", "duty"} <= categories,
+            f"/debug/flight covers flush+duty categories (got {sorted(categories)})",
+        )
+        status, body = await asyncio.to_thread(
+            _get, f"{base}/debug/flight?format=text"
+        )
+        gate(
+            status == 200 and b"duty_ok" in body,
+            "/debug/flight?format=text renders the incident timeline",
+        )
+
         server.close()
         await server.wait_closed()
         cluster.close()
+
+        # cross-node flight-recorder merge (ISSUE 19): every node dumps
+        # its own ring; the merged timeline is ONE wall-clock-ordered
+        # incident record covering all four nodes
+        dumps = cluster.dump_flight(trace_dir)
+        gate(len(dumps) == 4, "all 4 nodes dumped flight JSONL")
+        fmerged = flightrec.merge_jsonl(dumps)
+        gate(
+            {e["node"] for e in fmerged}
+            == {f"node{n.share_idx}" for n in cluster.nodes},
+            "flight merge covers all 4 nodes",
+        )
+        walls = [e["t_wall"] for e in fmerged]
+        gate(
+            walls == sorted(walls),
+            "flight merge is wall-clock ordered",
+        )
+        slot0 = slots[0]
+        duty_nodes = {
+            e["node"]
+            for e in fmerged
+            if e["category"] == "duty" and e["slot"] == slot0
+        }
+        gate(
+            len(duty_nodes) == 4,
+            f"slot {slot0}: duty outcome recorded on every node",
+        )
 
         # cross-node JSONL merge: one trace per duty, every wire edge
         # + cryptoplane stages, no orphan parentage

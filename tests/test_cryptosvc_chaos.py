@@ -271,6 +271,111 @@ def test_kill_mid_flush_both_clusters_zero_missed():
     asyncio.run(run())
 
 
+# -- 1b. post-mortem: the flight recorder names the fault (ISSUE 19) ---------
+
+
+def test_kill_mid_flush_postmortem_names_fault(tmp_path):
+    """ISSUE 19 acceptance: kill the shared crypto-plane server while
+    two tenants are verifying through it, dump each tenant node's
+    flight recorder, and assert the MERGED timeline names (a) the
+    aborted server endpoint, (b) the typed failover reason, and (c)
+    every affected tenant — the post-mortem an operator reads after a
+    real incident, reconstructed purely from the per-node dumps."""
+    from charon_tpu.app import flightrec
+
+    async def run():
+        impl = tbls.get_implementation()
+        sk = impl.generate_secret_key()
+        pk = impl.secret_to_public_key(sk)
+        items = [
+            (pk, bytes([i]) * 32, impl.sign(sk, bytes([i]) * 32))
+            for i in range(4)
+        ]
+
+        coal, svc = _shared_service()
+        server = CryptoServiceServer(svc, TOKENS, port=0)
+        await server.start()
+        addr = f"127.0.0.1:{server.port}"
+
+        locals_, clients, recs = [], [], {}
+        for tenant in ("c1", "c2"):
+            rec = flightrec.FlightRecorder(node=f"{tenant}-node0")
+            recs[tenant] = rec
+            local = SlotCoalescer(
+                SimHostPlane(3), window=0.005, decode_workers=2
+            )
+            locals_.append(local)
+            client = RemotePlane(
+                "127.0.0.1", server.port, tenant, TOKENS[tenant],
+                local=local,
+                observer=flightrec.remote_hook(rec, tenant, addr=addr),
+                heartbeat_timeout=2.0, request_timeout=4.0,
+            )
+            await client.start()
+            clients.append(client)
+        try:
+            # phase A: remote serving, recorded as connect events
+            await _wait_progress(
+                lambda: all(c.state != "down" for c in clients),
+                probe=lambda: tuple(c.connects for c in clients),
+            )
+            for client in clients:
+                assert await client.verify(list(items)) == [True] * 4
+
+            # phase B: SIGKILL mid-flight; every next round trip fails
+            # over down the local ladder with a typed reason
+            server.abort()
+            for client in clients:
+                assert await client.verify(list(items)) == [True] * 4
+            await _wait_progress(
+                lambda: all(
+                    sum(c.failovers.values()) > 0 for c in clients
+                ),
+                probe=lambda: tuple(
+                    sum(c.failovers.values()) for c in clients
+                ),
+            )
+
+            # phase C: each node dumps its OWN ring; the incident is
+            # reconstructed only from the merged JSONL
+            paths = []
+            for tenant, rec in recs.items():
+                path = str(tmp_path / f"{tenant}.flight.jsonl")
+                assert rec.dump_jsonl(path, trigger="demand") > 0
+                paths.append(path)
+            merged = flightrec.merge_jsonl(paths)
+            timeline = flightrec.render_timeline(merged)
+
+            # (a) the aborted server endpoint is named
+            assert addr in timeline
+            # (b) the failover carries its typed reason
+            failovers = [e for e in merged if e["kind"] == "failover"]
+            assert failovers
+            reasons = {e["fields"].get("reason") for e in failovers}
+            assert reasons <= {"down", "io", "timeout", "heartbeat"}
+            disconnects = [e for e in merged if e["kind"] == "disconnect"]
+            assert disconnects
+            # (c) every affected tenant appears, attributed to its node
+            assert {e["tenant"] for e in failovers} == {"c1", "c2"}
+            assert {e["node"] for e in merged} == {"c1-node0", "c2-node0"}
+            # wall-clock merge puts the connect epoch before the fault
+            kinds_in_order = [e["kind"] for e in merged]
+            assert kinds_in_order.index("connect") < kinds_in_order.index(
+                "failover"
+            )
+            for needle in ("failover", "c1", "c2", "reason="):
+                assert needle in timeline, needle
+        finally:
+            for client in clients:
+                await client.close()
+            svc.close()
+            coal.close()
+            for local in locals_:
+                local.close()
+
+    asyncio.run(run())
+
+
 # -- 2. socket-level misbehavior through the chaos proxy ---------------------
 
 

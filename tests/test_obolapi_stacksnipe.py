@@ -99,3 +99,49 @@ def test_stacksnipe_periodic_reports(tmp_path):
 
 def test_stacksnipe_real_proc_does_not_crash():
     snipe("/proc")  # whatever is running, must not raise
+
+
+def test_stacksnipe_gauge_hook_zeroes_departed_binaries(tmp_path):
+    """ISSUE 19 satellite: the run.py wiring publishes each scan as
+    stack_colocated_processes{binary} and zeroes binaries that vanished
+    between scans (a stale non-zero gauge would page forever)."""
+    from charon_tpu.app.metrics import ClusterMetrics
+
+    metrics = ClusterMetrics("0xdead", "test", "node0")
+    hook = metrics.stacksnipe_hook()
+
+    hook({"lighthouse": [101, 102], "teku": [7]})
+    rendered = metrics.render().decode()
+    assert 'binary="lighthouse"' in rendered
+    lh = [
+        line
+        for line in rendered.splitlines()
+        if line.startswith("stack_colocated_processes")
+        and 'binary="lighthouse"' in line
+    ]
+    assert lh and lh[0].endswith("2.0")
+
+    hook({"teku": [7]})  # lighthouse exited: its gauge must drop to 0
+    rendered = metrics.render().decode()
+    lh = [
+        line
+        for line in rendered.splitlines()
+        if line.startswith("stack_colocated_processes")
+        and 'binary="lighthouse"' in line
+    ]
+    assert lh and lh[0].endswith("0.0")
+
+    # end-to-end over a fake /proc: sniper loop feeds the same hook
+    p = tmp_path / "9"
+    p.mkdir()
+    (p / "cmdline").write_bytes(b"/usr/local/bin/prysm\x00--datadir\x00x\x00")
+
+    async def run():
+        sniper = StackSniper(interval=0.01, on_report=hook, proc_root=tmp_path)
+        sniper.start()
+        await asyncio.sleep(0.05)
+        await sniper.stop()
+
+    asyncio.run(run())
+    rendered = metrics.render().decode()
+    assert 'binary="prysm"' in rendered

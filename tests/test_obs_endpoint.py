@@ -82,6 +82,85 @@ def test_debug_duty_endpoint_timeline_and_404():
     asyncio.run(run())
 
 
+def test_debug_flight_endpoint_filters_views_and_404():
+    from charon_tpu.app import flightrec
+    from charon_tpu.app.planeprof import PlaneProfiler
+
+    async def run():
+        rec = flightrec.FlightRecorder(node="node0")
+        rec.record("tenant", "shed", tenant="tenant-a", slot=9, reason="queue")
+        rec.record("remote", "failover", tenant="tenant-a", reason="io")
+        rec.record("duty", "duty_ok", tenant="tenant-b", slot=10)
+        prof = PlaneProfiler()
+        prof.program_hook()("mesh/verify", 0.004, 64)
+        metrics = ClusterMetrics("0xdead", "test", "node0")
+        server = await serve_monitoring(
+            "127.0.0.1", 0, metrics, flightrec=rec, profiler=prof
+        )
+        port = server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+
+        def get(url):
+            with urllib.request.urlopen(url) as resp:
+                return resp.status, resp.read()
+
+        status, body = await asyncio.to_thread(get, f"{base}/debug/flight")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["schema"] == flightrec.SCHEMA_VERSION
+        assert doc["node"] == "node0"
+        assert [e["kind"] for e in doc["events"]] == [
+            "shed",
+            "failover",
+            "duty_ok",
+        ]
+
+        # filters: category, tenant, slot, limit
+        for query, kinds in (
+            ("category=remote", ["failover"]),
+            ("tenant=tenant-a", ["shed", "failover"]),
+            ("slot=9", ["shed"]),
+            ("limit=1", ["duty_ok"]),
+        ):
+            _, body = await asyncio.to_thread(
+                get, f"{base}/debug/flight?{query}"
+            )
+            got = [e["kind"] for e in json.loads(body)["events"]]
+            assert got == kinds, query
+
+        # plain-text incident timeline
+        status, body = await asyncio.to_thread(
+            get, f"{base}/debug/flight?format=text"
+        )
+        assert status == 200
+        text = body.decode()
+        assert "failover" in text and "tenant=tenant-a" in text
+
+        # profiler view
+        status, body = await asyncio.to_thread(
+            get, f"{base}/debug/flight?view=profile"
+        )
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["pending_samples"] == 1
+
+        server.close()
+        await server.wait_closed()
+
+        # no recorder wired -> 404, never a fake empty incident
+        bare = await serve_monitoring("127.0.0.1", 0, metrics)
+        bare_port = bare.sockets[0].getsockname()[1]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            await asyncio.to_thread(
+                get, f"http://127.0.0.1:{bare_port}/debug/flight"
+            )
+        assert exc.value.code == 404
+        bare.close()
+        await bare.wait_closed()
+
+    asyncio.run(run())
+
+
 def test_log_records_carry_trace_id(caplog):
     import logging
 

@@ -1,0 +1,291 @@
+"""Duty SLO engine + crypto-plane profiler tests (ISSUE 19): burn-rate
+math under an injected clock, multi-window alert edges, /readyz gating
+through SLOEngine.checks(), the plane health-check catalogue, and
+per-family / per-tenant flush attribution. Jax-free.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from charon_tpu.app.health import (
+    SEVERITY_CRITICAL,
+    SEVERITY_WARNING,
+    HealthChecker,
+    Metadata,
+    MetricStore,
+    SLOEngine,
+    SLO_DUTY_MISS,
+    SLO_STEP_LATENCY,
+    plane_checks,
+)
+from charon_tpu.app.planeprof import FALLBACK_FAMILY, PlaneProfiler
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def _fill(slo: SLOEngine, clock: FakeClock, n_bad: int, n_good: int) -> None:
+    for _ in range(n_bad):
+        slo.observe_duty(False)
+        clock.tick(1.0)
+    for _ in range(n_good):
+        slo.observe_duty(True)
+        clock.tick(1.0)
+
+
+# -- SLO engine --------------------------------------------------------------
+
+
+def test_burn_rate_silent_below_min_events():
+    clock = FakeClock()
+    slo = SLOEngine(min_events=10, clock=clock)
+    for _ in range(9):
+        slo.observe_duty(False)
+    assert slo.burn_rate(SLO_DUTY_MISS, "local", 300.0) == 0.0
+    slo.observe_duty(False)  # tenth event: now it speaks
+    assert slo.burn_rate(SLO_DUTY_MISS, "local", 300.0) > 0.0
+
+
+def test_burn_rate_math_and_budget_remaining():
+    clock = FakeClock()
+    # budget 10%: 2 bad out of 20 = 10% bad = burn 1.0 (exactly on pace)
+    slo = SLOEngine(duty_budget=0.10, min_events=10, clock=clock)
+    _fill(slo, clock, n_bad=2, n_good=18)
+    assert slo.burn_rate(SLO_DUTY_MISS, "local", 300.0) == pytest.approx(1.0)
+    assert slo.budget_remaining(SLO_DUTY_MISS, "local") == pytest.approx(0.0)
+
+    # all-bad burns at 1/budget and the remaining budget clamps at 0
+    slo2 = SLOEngine(duty_budget=0.10, min_events=10, clock=clock)
+    _fill(slo2, clock, n_bad=20, n_good=0)
+    assert slo2.burn_rate(SLO_DUTY_MISS, "local", 300.0) == pytest.approx(10.0)
+    assert slo2.budget_remaining(SLO_DUTY_MISS, "local") == 0.0
+
+
+def test_burn_rate_respects_window_cutoff():
+    clock = FakeClock()
+    slo = SLOEngine(duty_budget=0.10, min_events=5, clock=clock)
+    _fill(slo, clock, n_bad=10, n_good=0)  # all bad, then time passes
+    clock.tick(400.0)
+    _fill(slo, clock, n_bad=0, n_good=10)  # fresh good events
+    # fast window only sees the good tail
+    assert slo.burn_rate(SLO_DUTY_MISS, "local", 300.0) == 0.0
+    # slow window still remembers the bad head
+    assert slo.burn_rate(SLO_DUTY_MISS, "local", 3600.0) == pytest.approx(5.0)
+
+
+def test_multiwindow_alert_needs_both_windows():
+    clock = FakeClock()
+    alerts = []
+    slo = SLOEngine(
+        duty_budget=0.10,
+        min_events=5,
+        page_burn=6.0,
+        warn_burn=3.0,
+        on_alert=lambda s, t, sev: alerts.append((s, t, sev)),
+        clock=clock,
+    )
+    # old bad burst outside the fast window: slow burn high, fast silent
+    _fill(slo, clock, n_bad=10, n_good=0)
+    clock.tick(400.0)
+    _fill(slo, clock, n_bad=0, n_good=10)
+    rows = slo.evaluate()
+    (row,) = [r for r in rows if r["slo"] == SLO_DUTY_MISS]
+    assert row["severity"] == ""  # fast window vetoes the page
+    assert alerts == []
+
+    # now it burns in BOTH windows -> critical, single rising edge
+    clock.tick(400.0)
+    _fill(slo, clock, n_bad=10, n_good=0)
+    slo.evaluate()
+    slo.evaluate()  # steady state: no duplicate alert
+    assert alerts == [(SLO_DUTY_MISS, "local", SEVERITY_CRITICAL)]
+    assert slo.alerts_total[(SLO_DUTY_MISS, "local", SEVERITY_CRITICAL)] == 1
+    assert slo.firing(SLO_DUTY_MISS, SEVERITY_CRITICAL)
+
+
+def test_step_latency_slo_and_tenant_attribution():
+    clock = FakeClock()
+    slo = SLOEngine(
+        step_budget=0.10, step_latency_target=1.0, min_events=5, clock=clock
+    )
+    for _ in range(10):
+        slo.observe_step(2.0, tenant="tenant-a")  # all over target
+        slo.observe_step(0.1, tenant="tenant-b")  # all fine
+        clock.tick(1.0)
+    assert slo.burn_rate(
+        SLO_STEP_LATENCY, "tenant-a", 300.0
+    ) == pytest.approx(10.0)
+    assert slo.burn_rate(SLO_STEP_LATENCY, "tenant-b", 300.0) == 0.0
+    assert slo.tenants() == ["tenant-a", "tenant-b"]
+
+
+def test_slo_checks_gate_readyz():
+    clock = FakeClock()
+    slo = SLOEngine(duty_budget=0.01, min_events=5, clock=clock)
+    store = MetricStore(now=clock)
+    checker = HealthChecker(store, checks=slo.checks(), metadata=Metadata())
+    assert checker.healthy()
+
+    _fill(slo, clock, n_bad=20, n_good=0)
+    slo.evaluate()
+    failing = {c.name for c in checker.failing()}
+    assert "slo_duty_miss_burn" in failing
+    assert not checker.healthy()  # critical SLO burn flips readiness
+
+
+# -- plane check catalogue ---------------------------------------------------
+
+
+def test_plane_checks_catalogue():
+    clock = FakeClock()
+    store = MetricStore(now=clock)
+    md = Metadata(remote_plane=True)
+    checker = HealthChecker(store, checks=plane_checks(), metadata=md)
+    assert checker.healthy()
+    assert {c.name for c in checker.checks} == {
+        "tenant_breaker_open",
+        "remote_plane_down",
+        "remote_plane_probing",
+        "peer_quarantine_active",
+        "autotune_defaults",
+    }
+
+    # breaker open (2) is the only critical
+    store.sample("tpu_plane_tenant_breaker_state", 2)
+    assert not checker.healthy()
+    failing = {c.name: c.severity for c in checker.failing()}
+    assert failing["tenant_breaker_open"] == SEVERITY_CRITICAL
+
+    # remote down / probing warn but never gate
+    store.sample("tpu_plane_tenant_breaker_state", 0)
+    clock.tick(700.0)  # breaker sample ages out of the window
+    store.sample("tpu_plane_tenant_breaker_state", 0)
+    store.sample("tpu_plane_remote_state", 0)
+    names = {c.name for c in checker.failing()}
+    assert names == {"remote_plane_down"}
+    assert checker.healthy()
+    store.sample("tpu_plane_remote_state", 1)
+    assert {c.name for c in checker.failing()} == {"remote_plane_probing"}
+
+    # without a configured remote the remote checks stay quiet
+    md_local = Metadata(remote_plane=False)
+    local = HealthChecker(store, checks=plane_checks(), metadata=md_local)
+    assert "remote_plane_down" not in {c.name for c in local.failing()}
+
+    # quarantine: counter increase within the window
+    store.sample("tpu_plane_remote_state", 2)
+    store.sample("wire_peer_quarantine_total", 0)
+    store.sample("wire_peer_quarantine_total", 3)
+    assert "peer_quarantine_active" in {c.name for c in checker.failing()}
+
+    # autotune fell back to defaults
+    store.sample("tpu_autotune_fallback", 1)
+    assert "autotune_defaults" in {c.name for c in checker.failing()}
+
+
+# -- plane profiler ----------------------------------------------------------
+
+
+class Stats:
+    def __init__(self, device_span, lanes=64, tenant_lanes=()):
+        self.device_span = device_span
+        self.lanes = lanes
+        self.tenant_lanes = tenant_lanes
+
+
+def test_profiler_attributes_samples_to_flush():
+    clock = FakeClock()
+    samples, tenants, utils = [], [], []
+    prof = PlaneProfiler(
+        window=10.0,
+        on_sample=lambda f, s: samples.append((f, s)),
+        on_tenant=lambda t, s: tenants.append((t, s)),
+        on_utilization=utils.append,
+        clock=clock,
+    )
+    hook = prof.program_hook()
+    hook("mesh/verify_rlc", 0.006, 64)
+    hook("mesh/step", 0.002, 64)
+    prof.observe_flush(
+        Stats(
+            device_span=(100.0, 100.008),
+            tenant_lanes=(("tenant-a", 48), ("tenant-b", 16)),
+        )
+    )
+    assert prof.kernel_seconds["mesh/verify_rlc"] == pytest.approx(0.006)
+    assert prof.kernel_seconds["mesh/step"] == pytest.approx(0.002)
+    assert prof.kernel_calls == {"mesh/verify_rlc": 1, "mesh/step": 1}
+    # per-family sum equals device_span on the hooked path
+    assert sum(prof.kernel_seconds.values()) == pytest.approx(0.008)
+    assert samples == [("mesh/verify_rlc", 0.006), ("mesh/step", 0.002)]
+    # tenant split follows live-lane share: 48/64 and 16/64 of 8ms
+    assert prof.tenant_seconds["tenant-a"] == pytest.approx(0.006)
+    assert prof.tenant_seconds["tenant-b"] == pytest.approx(0.002)
+    assert tenants == [
+        ("tenant-a", pytest.approx(0.006)),
+        ("tenant-b", pytest.approx(0.002)),
+    ]
+    # duty cycle: 8ms busy over a 10s window
+    assert utils == [pytest.approx(0.0008)]
+    assert prof.flushes == 1
+
+
+def test_profiler_fallback_family_for_hookless_planes():
+    prof = PlaneProfiler(window=10.0, clock=FakeClock())
+    prof.observe_flush(Stats(device_span=(5.0, 5.25), lanes=32))
+    assert prof.kernel_seconds == {FALLBACK_FAMILY: pytest.approx(0.25)}
+    # fallback attribution equals device_span exactly
+    assert sum(prof.kernel_seconds.values()) == pytest.approx(0.25)
+
+
+def test_profiler_utilization_window_slides():
+    clock = FakeClock()
+    prof = PlaneProfiler(window=10.0, clock=clock)
+    prof.observe_flush(Stats(device_span=(0.0, 1.0)))
+    assert prof.utilization == pytest.approx(0.1)
+    clock.tick(20.0)  # the busy sample ages out
+    prof.observe_flush(Stats(device_span=(20.0, 20.0)))
+    assert prof.utilization == 0.0
+
+
+def test_profiler_stats_hook_chains_and_never_raises():
+    inner = []
+    prof = PlaneProfiler(window=10.0, clock=FakeClock())
+    hook = prof.stats_hook(inner=inner.append)
+    hook(object())  # no device_span anywhere: profiled as a no-op
+    assert inner and prof.flushes == 1
+
+    class Hostile:
+        @property
+        def device_span(self):
+            raise RuntimeError("stats shape drift")
+
+    hook(Hostile())  # observe_flush raises internally; inner still runs
+    assert len(inner) == 2
+
+
+def test_profiler_snapshot_shape():
+    prof = PlaneProfiler(window=10.0, clock=FakeClock())
+    prof.program_hook()("mesh/h2c", 0.001, 8)
+    snap = prof.snapshot()
+    assert snap["pending_samples"] == 1
+    assert snap["flushes"] == 0
+    assert set(snap) == {
+        "kernel_seconds",
+        "kernel_calls",
+        "tenant_seconds",
+        "flushes",
+        "utilization",
+        "pending_samples",
+    }
+    with pytest.raises(ValueError):
+        PlaneProfiler(window=0.0)
