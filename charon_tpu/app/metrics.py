@@ -571,6 +571,36 @@ class ClusterMetrics:
             labels + ["binary"],
             registry=self.registry,
         )
+        # device-accelerated ceremonies (ISSUE 20): verification lanes
+        # by ceremony stage and execution path, plus the resharing
+        # lifecycle (operator join/leave, threshold change, proactive
+        # rotation) as a live, benchmarked workload
+        self.dkg_verify_lanes = counter(
+            "dkg_verify_lanes_total",
+            "Ceremony verification lanes by stage (pok / share / "
+            "pubshare_eval / reshare_share / reshare_pubshare) and "
+            "execution path (device batched kernels vs host bigint)",
+            ["stage", "path"],
+        )
+        self.dkg_reshare_total = counter(
+            "dkg_reshare_total",
+            "Key resharing ceremonies by kind (join / leave / "
+            "threshold / rotate) and result (ok / error)",
+            ["kind", "result"],
+        )
+        self.dkg_reshare_seconds = Histogram(
+            "dkg_reshare_seconds",
+            "Wall seconds per resharing ceremony (rounds + share "
+            "derivation, excluding transport wait on remote dealers)",
+            labels,
+            registry=self.registry,
+            buckets=(0.05, 0.2, 1.0, 5.0, 20.0, 60.0, 300.0),
+        )
+        self.dkg_reshare_validators = counter(
+            "dkg_reshare_validators_total",
+            "Validators whose shares were rotated by completed "
+            "resharing ceremonies",
+        )
 
     def labels(self, metric, *extra):
         return metric.labels(*self._label_values, *extra)
@@ -592,6 +622,31 @@ class ClusterMetrics:
             self.labels(self.point_cache_hits, name).set(info.hits)
             self.labels(self.point_cache_misses, name).set(info.misses)
             self.labels(self.point_cache_size, name).set(info.currsize)
+
+    def observe_dkg_verify(self, stage: str, path: str, lanes: int) -> None:
+        """Record one ceremony verification wave: `lanes` checks of
+        `stage` served by `path` ("device" batched kernels or "host"
+        python bigint fallback)."""
+        if lanes:
+            self.labels(self.dkg_verify_lanes, stage, path).inc(lanes)
+
+    def observe_reshare(
+        self,
+        kind: str,
+        result: str,
+        seconds: float | None = None,
+        validators: int = 0,
+    ) -> None:
+        """Record one resharing ceremony outcome. `kind` is the
+        operator-facing mode (join / leave / threshold / rotate),
+        `validators` the rotated share count on success."""
+        self.labels(self.dkg_reshare_total, kind, result).inc()
+        if seconds is not None:
+            self.labels(self.dkg_reshare_seconds).observe(
+                max(0.0, float(seconds))
+            )
+        if validators:
+            self.labels(self.dkg_reshare_validators).inc(validators)
 
     def observe_warmup(self, stats: dict) -> None:
         """Record one bulk warm-up pass (the stats dict returned by

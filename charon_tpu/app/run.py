@@ -184,6 +184,39 @@ class Node:
     flightrec: object | None = None  # app/flightrec.FlightRecorder
     profiler: object | None = None  # app/planeprof.PlaneProfiler
     slo: object | None = None  # app/health.SLOEngine
+    # the live pubshare registry (shared with Eth2Verifier/ValidatorAPI
+    # by reference) — apply_reshare rotates it in place
+    pubshares_by_idx: dict | None = None
+
+    async def apply_reshare(
+        self, new_pubshares_by_idx: dict, kind: str = "rotate"
+    ) -> dict:
+        """Rotate the live pubshare registry after a completed resharing
+        ceremony (dkg/reshare) and re-warm the point caches for the
+        delta only. The registry dicts are shared by reference with
+        Eth2Verifier and ValidatorAPI, so the in-place update takes
+        effect on the next partial-signature verification — partials
+        signed with pre-reshare shares stop verifying from that moment
+        (the stale-share unusability property). Returns the warm-up
+        stats dict; already-cached pubshares cost zero lanes."""
+        if self.pubshares_by_idx is None:
+            raise RuntimeError("node was built without a pubshare registry")
+        delta: list[bytes] = []
+        for idx, shares in new_pubshares_by_idx.items():
+            reg = self.pubshares_by_idx.setdefault(idx, {})
+            for gpk, pub in shares.items():
+                if reg.get(gpk) != pub:
+                    delta.append(pub)
+                reg[gpk] = pub
+        stats = await self.rewarm_point_caches(pubkeys=delta)
+        self.metrics.observe_reshare(
+            kind,
+            "ok",
+            validators=max(
+                (len(s) for s in new_pubshares_by_idx.values()), default=0
+            ),
+        )
+        return stats
 
     async def rewarm_point_caches(
         self, pubkeys=(), messages=()
@@ -1557,6 +1590,7 @@ async def build_node(config: Config) -> Node:
         flightrec=flight,
         profiler=profiler,
         slo=slo,
+        pubshares_by_idx=pubshares_by_idx,
     )
 
 

@@ -409,6 +409,35 @@ def build_parser() -> argparse.ArgumentParser:
         "--force", action="store_true", help="overwrite existing output"
     )
 
+    resh = sub.add_parser(
+        "reshare",
+        help="reshare validator key shares to a new operator set or "
+        "threshold (group key unchanged, old shares retired)",
+    )
+    resh.add_argument(
+        "--cluster-dir",
+        required=True,
+        help="directory containing node*/ data dirs from the same cluster",
+    )
+    resh.add_argument(
+        "--new-nodes",
+        type=int,
+        default=0,
+        help="new operator count (join/leave); 0 = unchanged",
+    )
+    resh.add_argument(
+        "--threshold",
+        type=int,
+        default=0,
+        help="new threshold; 0 = BFT default n - floor((n-1)/3) for "
+        "the new operator count",
+    )
+    resh.add_argument(
+        "--no-tpu",
+        action="store_true",
+        help="host-only ceremony verification (skip the device engine)",
+    )
+
     exitp = sub.add_parser("exit", help="voluntary-exit operations")
     exitsub = exitp.add_subparsers(dest="exit_command", required=True)
     esign = exitsub.add_parser(
@@ -1003,6 +1032,160 @@ def cmd_combine(args) -> int:
     return 0
 
 
+def cmd_reshare(args) -> int:
+    """Local resharing ceremony over a cluster directory (dkg/reshare):
+    operator join/leave, threshold change, or proactive rotation — the
+    group keys never change, every share does. Keystores swap in
+    atomically per node dir (the pre-reshare set stays at
+    validator_keys.pre-reshare until the operator retires it); the new
+    pubshare map lands in reshare-pubshares.json for the lock/manifest
+    update. See docs/operations.md "Key resharing at scale"."""
+    from charon_tpu import tbls
+    from charon_tpu.cluster.manifest import load_cluster_state
+    from charon_tpu.crypto.g1g2 import g1_from_bytes, g1_to_bytes
+    from charon_tpu.dkg import reshare
+    from charon_tpu.eth2util import keystore
+
+    cluster_dir = Path(args.cluster_dir)
+    node_dirs = sorted(
+        d
+        for d in cluster_dir.iterdir()
+        if d.is_dir() and (d / "cluster-lock.json").exists()
+    )
+    if not node_dirs:
+        print(f"no node dirs with cluster-lock.json in {cluster_dir}", file=sys.stderr)
+        return 1
+    lock = load_cluster_state(node_dirs[0])
+    n = len(lock.definition.operators)
+    t = lock.definition.threshold
+    v = len(lock.validators)
+    pubshare_rows = [
+        [bytes.fromhex(s[2:]) for s in val.public_shares]
+        for val in lock.validators
+    ]
+
+    # map each dir to its share index by matching keystore pubshares
+    # (cmd_combine idiom) — dealers are exactly the old nodes present
+    impl = tbls.get_implementation()
+    dirs_by_idx: dict[int, Path] = {}
+    secrets_by_idx: dict[int, list[int]] = {}
+    for d in node_dirs:
+        if load_cluster_state(d).lock_hash() != lock.lock_hash():
+            print(f"{d} belongs to a different cluster", file=sys.stderr)
+            return 1
+        secrets = keystore.load_keys(d / "validator_keys")
+        if len(secrets) != v:
+            print(f"{d} has {len(secrets)} keystores, want {v}", file=sys.stderr)
+            return 1
+        pub = impl.secret_to_public_key(secrets[0])
+        if pub not in pubshare_rows[0]:
+            print(f"{d} keystore matches no pubshare", file=sys.stderr)
+            return 1
+        idx = pubshare_rows[0].index(pub) + 1
+        dirs_by_idx[idx] = d
+        secrets_by_idx[idx] = [int.from_bytes(s, "big") for s in secrets]
+
+    old_indices = tuple(sorted(dirs_by_idx))
+    if len(old_indices) < t:
+        print(
+            f"need >= threshold ({t}) node dirs to reshare, got "
+            f"{len(old_indices)}",
+            file=sys.stderr,
+        )
+        return 1
+    n_new = args.new_nodes or n
+    t_new = args.threshold or (n_new - (n_new - 1) // 3)
+    new_indices = tuple(range(1, n_new + 1))
+    try:
+        cfg = reshare.ReshareConfig(
+            old_indices=old_indices,
+            new_indices=new_indices,
+            t_old=t,
+            t_new=t_new,
+            num_validators=v,
+        )
+    except reshare.ReshareError as e:
+        print(f"bad reshare parameters: {e}", file=sys.stderr)
+        return 1
+
+    old_pubshares = [
+        {j: g1_from_bytes(row[j - 1]) for j in range(1, n + 1)}
+        for row in pubshare_rows
+    ]
+    group_pubkeys = [
+        g1_from_bytes(bytes.fromhex(val.distributed_public_key[2:]))
+        for val in lock.validators
+    ]
+    engine = None
+    if not args.no_tpu:
+        try:
+            from charon_tpu.ops.blsops import BlsEngine
+
+            engine = BlsEngine()
+        except Exception as e:  # noqa: BLE001 — host-only fallback
+            print(f"device engine unavailable ({e}); verifying on host")
+
+    participants = sorted(set(old_indices) | set(new_indices))
+    transport = reshare.MemReshareTransport(dealer_indices=old_indices)
+
+    async def ceremony():
+        return await asyncio.gather(
+            *(
+                reshare.run_reshare_parallel(
+                    transport.participant(i),
+                    i,
+                    cfg,
+                    old_pubshares,
+                    group_pubkeys,
+                    share_secrets=secrets_by_idx.get(i),
+                    engine=engine,
+                )
+                for i in participants
+            )
+        )
+
+    try:
+        results = dict(zip(participants, run_coro(ceremony())))
+    except reshare.ReshareError as e:
+        print(f"reshare aborted: {e}", file=sys.stderr)
+        return 1
+
+    pubshare_map: dict[int, list[str]] = {}
+    for j in new_indices:
+        res = results[j]
+        target = dirs_by_idx.get(j, cluster_dir / f"node{j - 1}")
+        hexes = [
+            "0x" + g1_to_bytes(r.pubshares[j]).hex() for r in res
+        ]
+        pubshare_map[j] = hexes
+        reshare.write_reshare_outputs(target, res, pubshare_hexes=hexes)
+    (cluster_dir / "reshare-pubshares.json").write_text(
+        json.dumps(
+            {
+                "threshold": t_new,
+                "num_operators": n_new,
+                "public_shares": {str(j): pubshare_map[j] for j in new_indices},
+            },
+            indent=2,
+        )
+    )
+    left = sorted(set(old_indices) - set(new_indices))
+    print(
+        f"reshared {v} validator(s): {len(old_indices)} dealers -> "
+        f"{n_new} operators (threshold {t} -> {t_new})"
+    )
+    if left:
+        print(
+            f"operators {left} left the cluster — retire their "
+            "validator_keys.pre-reshare directories"
+        )
+    print(
+        "new pubshares in reshare-pubshares.json; update the cluster "
+        "lock/manifest before restarting nodes"
+    )
+    return 0
+
+
 def cmd_exit(args) -> int:
     from charon_tpu import tbls
     from charon_tpu.cluster.manifest import load_cluster_state
@@ -1483,6 +1666,7 @@ def main(argv=None) -> int:
         "sign-definition": cmd_sign_definition,
         "enr": cmd_enr,
         "combine": cmd_combine,
+        "reshare": cmd_reshare,
         "exit": cmd_exit,
         "flight": cmd_flight,
         "relay": cmd_relay,

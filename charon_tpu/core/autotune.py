@@ -131,9 +131,17 @@ class KernelConfig:
     mxu_mont: bool = False  # int8-MXU Montgomery decomposition
     fp2_fusion: bool = True  # fused-Fp2 Pallas kernels (needs pallas)
     pallas: bool | None = None  # None = auto (TPU + uint32 geometry)
+    ceremony_straus: bool = True  # Straus vs per-lane in commitment eval
+    ceremony_msm_w8: bool = True  # Pippenger window 8 (else 4) in g1_msm
 
     # the axes resolve()/micro_bench() may tune (bool-valued)
-    TUNABLE = ("msm", "mxu_mont", "fp2_fusion")
+    TUNABLE = (
+        "msm",
+        "mxu_mont",
+        "fp2_fusion",
+        "ceremony_straus",
+        "ceremony_msm_w8",
+    )
 
     def apply(self) -> bool:
         """Push this config into the trace-time dispatch flags and drop
@@ -147,6 +155,8 @@ class KernelConfig:
         except ImportError:
             return False
         MSM.set_msm(self.msm)
+        MSM.set_ceremony_straus(self.ceremony_straus)
+        MSM.set_ceremony_window(8 if self.ceremony_msm_w8 else 4)
         limb.set_mxu(self.mxu_mont)
         limb.set_pallas(self.pallas)
         fptower.set_fp2_fusion(self.fp2_fusion)
@@ -296,6 +306,58 @@ def _fp2_batch_builder(lanes: int) -> Callable[[], None]:
     return run
 
 
+def _ceremony_eval_builder(lanes: int, t: int = 3) -> Callable[[], None]:
+    """DKG commitment-polynomial evaluation wave — the kernel the
+    ceremony_straus axis routes (blsops._commitment_eval_kernel: Straus
+    joint windowed mul vs per-lane double-and-add + fold)."""
+    import jax
+    import numpy as np
+
+    from charon_tpu.crypto.g1g2 import G1_GEN
+    from charon_tpu.ops import blsops, limb
+    from charon_tpu.ops import curve as C
+
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    n = blsops.bucket_lanes(lanes)
+    commits = C.g1_pack(ctx, [G1_GEN] * (n * t))
+    commits = jax.tree_util.tree_map(
+        lambda a: a.reshape((n, t) + a.shape[1:]), commits
+    )
+    xs = np.arange(1, n + 1, dtype=np.int32)
+    fn = blsops._commitment_eval_kernel(ctx, fr_ctx, 1, t, 32)
+
+    def run() -> None:
+        jax.block_until_ready(fn(commits, xs))
+
+    return run
+
+
+def _ceremony_msm_builder(lanes: int) -> Callable[[], None]:
+    """Segmented G1 MSM burst — the kernel the ceremony_msm_w8 axis
+    sizes (Pippenger bucket window 8 vs 4 in blsops._g1_msm_kernel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from charon_tpu.crypto.g1g2 import G1_GEN
+    from charon_tpu.ops import blsops, limb
+    from charon_tpu.ops import curve as C
+
+    ctx, fr_ctx = limb.default_fp_ctx(), limb.default_fr_ctx()
+    n = blsops.bucket_lanes(lanes)
+    pts = C.g1_pack(ctx, [G1_GEN] * n)
+    scalars = jnp.asarray(
+        limb.ctx_pack(fr_ctx, [i + 1 for i in range(n)])
+    )
+    seg = jnp.asarray(np.zeros(n, dtype=np.int32))
+    fn = blsops._g1_msm_kernel(ctx, fr_ctx, 1, 255)
+
+    def run() -> None:
+        jax.block_until_ready(fn(pts, scalars, seg))
+
+    return run
+
+
 def _always(_=None) -> bool:
     return True
 
@@ -353,6 +415,22 @@ register_candidate(
         doc="fused-Fp2 Pallas kernels vs stacked-XLA fp2 level",
         applicable=_fp2_applicable,
         builder=_fp2_batch_builder,
+    )
+)
+register_candidate(
+    Candidate(
+        field="ceremony_straus",
+        doc="Straus joint mul vs per-lane in DKG commitment eval",
+        applicable=_always,
+        builder=_ceremony_eval_builder,
+    )
+)
+register_candidate(
+    Candidate(
+        field="ceremony_msm_w8",
+        doc="Pippenger window 8 vs 4 in ceremony segmented G1 MSM",
+        applicable=_always,
+        builder=_ceremony_msm_builder,
     )
 )
 

@@ -189,6 +189,7 @@ class FrostParticipant:
         self._verify_poks(broadcasts, engine)
         self._verify_shares(broadcasts, my_shares, engine)
 
+        pubshare_rows = self._derive_pubshares(broadcasts, engine)
         results = []
         for v in range(self.v):
             group_pk = None
@@ -198,18 +199,46 @@ class FrostParticipant:
                 secret_share = (
                     secret_share + my_shares[i].shares[v]
                 ) % R
-            pubshares = {
-                j: self._eval_commitments(broadcasts, v, j)
-                for j in range(1, self.n + 1)
-            }
             results.append(
                 FrostResult(
                     group_pubkey=group_pk,
                     secret_share=secret_share,
-                    pubshares=pubshares,
+                    pubshares=pubshare_rows[v],
                 )
             )
         return results
+
+    def _derive_pubshares(self, broadcasts, engine) -> list[dict]:
+        """Per validator: {j: pubshare_j} for every node j.
+
+        Device path: ONE commitment_eval_batch over all (validator, j)
+        lanes — each lane evaluates the n concatenated commitment
+        vectors at x=j and sums them in-graph (sum_i sum_k C_ik j^k).
+        The host path is the original sequential loop."""
+        if engine is None:
+            return [
+                {
+                    j: self._eval_commitments(broadcasts, v, j)
+                    for j in range(1, self.n + 1)
+                }
+                for v in range(self.v)
+            ]
+        rows, xs = [], []
+        for v in range(self.v):
+            for j in range(1, self.n + 1):
+                row: list = []
+                for i in range(1, self.n + 1):
+                    row.extend(broadcasts[i][v].commitments)
+                rows.append(row)
+                xs.append(j)
+        evals = engine.commitment_eval_batch(rows, xs, self.t)
+        out = []
+        for v in range(self.v):
+            base = v * self.n
+            out.append(
+                {j: evals[base + j - 1] for j in range(1, self.n + 1)}
+            )
+        return out
 
     def _eval_commitments(self, broadcasts, v: int, j: int):
         """Pubshare of node j for validator v: sum_i sum_k C_ik * j^k."""
@@ -232,9 +261,8 @@ class FrostParticipant:
                 scalars.append(c)
                 rhs.append((i, v, b))
         if engine is not None:
-            lhs = engine.g1_scalar_mul_batch(
-                [G1_GEN] * len(scalars), [b.pok_mu for (_, _, b) in rhs]
-            )
+            # fixed-base table kernel for the G1_GEN side (no doublings)
+            lhs = engine.g1_gen_mul_batch([b.pok_mu for (_, _, b) in rhs])
             a0c = engine.g1_scalar_mul_batch(bases, scalars)
         else:
             lhs = [g1_mul(G1_GEN, b.pok_mu) for (_, _, b) in rhs]
@@ -248,27 +276,38 @@ class FrostParticipant:
     def _verify_shares(self, broadcasts, my_shares, engine) -> None:
         """g*f_i(me) == sum_k C_ik * me^k for every (peer, validator).
 
-        The commitment evaluations are the ceremony's compute bulk — one
-        batched device call for all (peer, validator, k) scalar-muls."""
+        The commitment evaluations are the ceremony's compute bulk — the
+        device path runs them as ONE commitment_eval_batch program (a
+        shared Straus doubling chain per (peer, validator) lane) plus a
+        fixed-base table mul for the g*share side. Share scalars ride
+        the device only as packed limbs (they never leave this
+        process); everything that comes back is a public curve point."""
         tasks = []  # (i, v, share)
-        muls_b, muls_s = [], []
         for i in range(1, self.n + 1):
             for v in range(self.v):
-                share = my_shares[i].shares[v]
-                tasks.append((i, v, share))
-                xpow = 1
-                for c in broadcasts[i][v].commitments:
-                    muls_b.append(c)
-                    muls_s.append(xpow)
-                    xpow = xpow * self.idx % R
+                tasks.append((i, v, my_shares[i].shares[v]))
         if engine is not None:
-            lhs = engine.g1_scalar_mul_batch(
-                [G1_GEN] * len(tasks), [s for (_, _, s) in tasks]
+            lhs = engine.g1_gen_mul_batch([s for (_, _, s) in tasks])
+            rhs = engine.commitment_eval_batch(
+                [broadcasts[i][v].commitments for (i, v, _) in tasks],
+                [self.idx] * len(tasks),
+                self.t,
             )
-            terms = engine.g1_scalar_mul_batch(muls_b, muls_s)
-        else:
-            lhs = [g1_mul(G1_GEN, s) for (_, _, s) in tasks]
-            terms = [g1_mul(b, s) for b, s in zip(muls_b, muls_s)]
+            for (i, v, _), l, r in zip(tasks, lhs, rhs):
+                if l != r:
+                    raise ValueError(
+                        f"invalid share from peer {i} (validator {v})"
+                    )
+            return
+        muls_b, muls_s = [], []
+        for i, v, _ in tasks:
+            xpow = 1
+            for c in broadcasts[i][v].commitments:
+                muls_b.append(c)
+                muls_s.append(xpow)
+                xpow = xpow * self.idx % R
+        lhs = [g1_mul(G1_GEN, s) for (_, _, s) in tasks]
+        terms = [g1_mul(b, s) for b, s in zip(muls_b, muls_s)]
         k = self.t
         for n_task, (i, v, _) in enumerate(tasks):
             acc = None

@@ -280,12 +280,49 @@ def _register_engine_families() -> None:
             _g2_scalar_mul_kernel(ctx, fr_ctx), (sig, s), ctx, n
         )
 
+    def _gen_mul(ctx, fr_ctx):
+        s = _C.fr_pack(fr_ctx, [1] * n)
+        return TraceSpec(
+            _g1_gen_mul_kernel(ctx, fr_ctx, 255, 4), (s,), ctx, n
+        )
+
+    def _ceval(ctx, fr_ctx):
+        pk, _, _ = _pts(ctx, n * t)
+        grid = jax.tree_util.tree_map(
+            lambda a: a.reshape(n, t, *a.shape[1:]), pk
+        )
+        xs = jnp.arange(1, n + 1, dtype=jnp.int32)
+        return TraceSpec(
+            _commitment_eval_kernel(ctx, fr_ctx, 1, t, 32), (grid, xs), ctx, n
+        )
+
+    def _g1msm(ctx, fr_ctx):
+        pk, _, _ = _pts(ctx, n)
+        s = jnp.asarray(limb.ctx_pack(fr_ctx, [1] * n))
+        seg = jnp.zeros((n,), jnp.int32)
+        return TraceSpec(
+            _g1_msm_kernel(ctx, fr_ctx, 1, 255), (pk, s, seg), ctx, n
+        )
+
+    def _lag_at(ctx, fr_ctx):
+        idx = jnp.asarray(
+            np.tile(np.arange(1, t + 1, dtype=np.int32), (n, 1))
+        )
+        xs = jnp.arange(1, n + 1, dtype=jnp.int32)
+        return TraceSpec(_lagrange_at_kernel(fr_ctx, t), (idx, xs), ctx, n)
+
     heavy = {
         "verify": _verify,
         "verify_rlc": _verify_rlc,
         "verify_grouped_rlc": _verify_grouped,
         "threshold_agg": _thr_agg,
         "hash_to_g2": _h2c,
+        # ceremony families (ISSUE 20): fixed-base gather-adds, the
+        # Straus/per-lane commitment evaluation, and the reshare
+        # Pippenger MSM — curve-heavy graphs, digest-covered
+        "g1_gen_mul": _gen_mul,
+        "commitment_eval": _ceval,
+        "g1_msm": _g1msm,
     }
     cheap = {
         "aggregate": _agg,
@@ -296,6 +333,9 @@ def _register_engine_families() -> None:
         "decompress_g1": _dec_g1,
         "g1_scalar_mul": _g1_mul,
         "g2_scalar_mul": _g2_mul,
+        # pure-Fr Lagrange rows at arbitrary points (resharing): cheap
+        # enough to sentinel-trace every analysis run
+        "lagrange_at": _lag_at,
     }
 
     def _bind(builder):
@@ -314,7 +354,12 @@ def _register_engine_families() -> None:
         )
     # uint32-geometry sentinels: cheap ladder kernels where an implicit
     # 64-bit promotion would silently wreck TPU throughput
-    for fname in ("subgroup_g1", "g1_scalar_mul", "decompress_g1"):
+    for fname in (
+        "subgroup_g1",
+        "g1_scalar_mul",
+        "decompress_g1",
+        "lagrange_at",
+    ):
         register_kernel_family(
             f"blsops32/{fname}", _bind32(cheap[fname]), sentinel=True
         )
@@ -371,6 +416,53 @@ def lagrange_coeffs_at_zero(fr_ctx: ModCtx, idx, t: int):
     den = jnp.stack(dens, axis=-2)
     coeff = limb.mont_mul(fr_ctx, num, limb.inv_mod(fr_ctx, den))
     return limb.from_mont(fr_ctx, coeff)  # raw, for the bit schedule
+
+
+def lagrange_coeffs_at(fr_ctx: ModCtx, idx, t: int, xs):
+    """Batched Lagrange basis at ARBITRARY evaluation points — the
+    resharing generalization of lagrange_coeffs_at_zero (ISSUE 20).
+
+        coeff_j(x) = prod_{m != j} (x - x_m) / (x_j - x_m)   (mod r)
+
+    idx is (..., t) int32 of distinct share indices; xs is (...,) int32
+    evaluation points (one per batch lane). Returns raw Fr limbs
+    (..., t, n_limbs). At x = 0 this reduces to the zero-point basis
+    above (kept as separate code so the blessed duty-path graph is
+    untouched)."""
+    x_mont = limb.to_mont(fr_ctx, _indices_to_fr(fr_ctx, idx))  # (..., t, L)
+    e_mont = limb.to_mont(fr_ctx, _indices_to_fr(fr_ctx, xs))  # (..., L)
+    pts = [x_mont[..., j, :] for j in range(t)]
+    nums, dens = [], []
+    for j in range(t):
+        num = None
+        den = None
+        for m in range(t):
+            if m == j:
+                continue
+            nm = limb.sub_mod(fr_ctx, e_mont, pts[m])
+            num = nm if num is None else limb.mont_mul(fr_ctx, num, nm)
+            d = limb.sub_mod(fr_ctx, pts[j], pts[m])
+            den = d if den is None else limb.mont_mul(fr_ctx, den, d)
+        if num is None:  # t == 1
+            num = limb.const(fr_ctx, 1, pts[j].shape[:-1])
+            den = limb.const(fr_ctx, 1, pts[j].shape[:-1])
+        nums.append(num)
+        dens.append(den)
+    num = jnp.stack(nums, axis=-2)  # (..., t, L)
+    den = jnp.stack(dens, axis=-2)
+    coeff = limb.mont_mul(fr_ctx, num, limb.inv_mod(fr_ctx, den))
+    return limb.from_mont(fr_ctx, coeff)
+
+
+def _mont_powers(fr_ctx: ModCtx, xs, t: int):
+    """int32 evaluation points (...,) -> Montgomery-domain powers
+    x^0..x^(t-1), shape (..., t, n_limbs). t is static and small, so the
+    chain unrolls into t-1 mont_muls."""
+    x = limb.to_mont(fr_ctx, _indices_to_fr(fr_ctx, xs))
+    pows = [limb.const(fr_ctx, 1, x.shape[:-1])]
+    for _ in range(1, t):
+        pows.append(limb.mont_mul(fr_ctx, pows[-1], x))
+    return jnp.stack(pows, axis=-2)
 
 
 # ---------------------------------------------------------------------------
@@ -542,6 +634,134 @@ def _g2_scalar_mul_kernel(ctx: ModCtx, fr_ctx: ModCtx):
         return C.point_to_affine(f, C.point_scalar_mul(f, fr_ctx, proj, scalars))
 
     return _jit_kernel(kernel)
+
+
+# ---------------------------------------------------------------------------
+# Ceremony kernels: DKG verification + key resharing (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _gen_table_g1(ctx: ModCtx, nbits: int, window: int):
+    """Fixed-base window table for the G1 generator: packed affine
+    multiples T[win][d] = d * 2^(window*win) * G, computed ONCE on the
+    host (public constants). With the table baked into the graph the
+    kernel needs zero doublings — one gathered add per window."""
+    from charon_tpu.crypto.g1g2 import G1_GEN, g1_add
+
+    n_win = -(-nbits // window)
+    flat = []
+    base = G1_GEN
+    for _ in range(n_win):
+        entry = None
+        for _d in range(1 << window):
+            flat.append(entry)
+            entry = g1_add(entry, base)
+        for _ in range(window):
+            base = g1_add(base, base)
+    packed = C.g1_pack(ctx, flat)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape(n_win, 1 << window, *a.shape[1:]), packed
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _g1_gen_mul_kernel(ctx: ModCtx, fr_ctx: ModCtx, nbits: int, window: int):
+    """Batched fixed-base scalar mul [k_i] G — the DKG share/PoK check
+    LHS. Replaces the generic 255-double ladder with table gathers:
+    ~nbits/window complete adds per lane, no doublings."""
+    f = C.g1_ops(ctx)
+    from charon_tpu.ops import msm as MSM
+
+    table = _gen_table_g1(ctx, nbits, window)
+    n_win = -(-nbits // window)
+
+    def kernel(scalars):
+        digits = MSM._digits(fr_ctx, scalars, nbits, window)  # (N, n_win)
+        win = jnp.arange(n_win, dtype=jnp.int32)[None, :]
+        sel = jax.tree_util.tree_map(lambda a: a[win, digits], table)
+        proj = C.affine_to_point(f, sel)  # batch (N, n_win)
+        # reduce the window axis with a lax.scan — ONE add body in the
+        # compiled graph instead of n_win-1 unrolled point adds
+        from jax import lax
+
+        xs = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 1, 0), proj)
+        template = jax.tree_util.tree_leaves(proj)[0][:, 0]
+        init = jax.tree_util.tree_map(
+            lambda a: limb.match_vary(a, template),
+            C.point_identity(f, (digits.shape[0],)),
+        )
+        acc, _ = lax.scan(
+            lambda acc, p: (C.point_add(f, acc, p), None), init, xs
+        )
+        return C.point_to_affine(f, acc)
+
+    return _jit_kernel(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _commitment_eval_kernel(
+    ctx: ModCtx, fr_ctx: ModCtx, vecs: int, t: int, nbits: int
+):
+    """Per lane: sum over `vecs` commitment vectors of sum_k C_k x^k —
+    the Feldman/FROST commitment-polynomial evaluation that dominates
+    ceremony verification. The x^k powers are built in-graph from the
+    public int32 evaluation point; routing between Straus joint
+    windowed mul (one shared doubling chain over all vecs*t points per
+    lane) and per-lane double-and-add is owned by
+    core/autotune.KernelConfig via msm.set_ceremony_straus."""
+    f = C.g1_ops(ctx)
+    from charon_tpu.ops import msm as MSM
+
+    def kernel(commit_affine, xs):
+        # commit_affine: affine leaves (N, vecs*t, ...); xs: int32 (N,)
+        pows = limb.from_mont(fr_ctx, _mont_powers(fr_ctx, xs, t))
+        pows = jnp.tile(pows, (1, vecs, 1))  # (N, vecs*t, L)
+        proj = C.affine_to_point(f, commit_affine)
+        if MSM.ceremony_straus_active():
+            total = MSM.windowed_joint_mul(
+                f, fr_ctx, proj, pows, nbits=nbits, window=4
+            )
+        else:
+            scaled = C.point_scalar_mul(f, fr_ctx, proj, pows, nbits=nbits)
+            total = C.point_sum(f, scaled, axis=-1)
+        return C.point_to_affine(f, total)
+
+    return _jit_kernel(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _g1_msm_kernel(ctx: ModCtx, fr_ctx: ModCtx, n_segments: int, nbits: int):
+    """Segmented G1 Pippenger MSM over full-width scalars — the reshare
+    pubshare recombination sum_i lambda_i m^k D_ik. Window width is the
+    autotuned ceremony axis (msm.ceremony_window)."""
+    f = C.g1_ops(ctx)
+    from charon_tpu.ops import msm as MSM
+
+    def kernel(points_affine, scalars, segment_ids):
+        proj = C.affine_to_point(f, points_affine)
+        out = MSM.msm_segmented(
+            f,
+            fr_ctx,
+            proj,
+            scalars,
+            segment_ids,
+            n_segments,
+            nbits=nbits,
+            window=MSM.ceremony_window(),
+        )
+        return C.point_to_affine(f, out)
+
+    return _jit_kernel(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _lagrange_at_kernel(fr_ctx: ModCtx, t: int):
+    """Batched Lagrange basis rows at arbitrary evaluation points (pure
+    Fr — no curve ops)."""
+    return _jit_kernel(
+        lambda idx, xs: lagrange_coeffs_at(fr_ctx, idx, t, xs)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -846,6 +1066,108 @@ class BlsEngine:
         s = C.fr_pack(self.fr_ctx, list(scalars) + [0] * (pad - n))
         out = _g2_scalar_mul_kernel(self.ctx, self.fr_ctx)(base, s)
         return C.g2_unpack(self.ctx, out)[:n]
+
+    # -- ceremony kernels (DKG verification + resharing, ISSUE 20) -------
+
+    @staticmethod
+    def _eval_nbits(t: int, xs) -> int:
+        """Tight-but-bucketed bit schedule for x^k powers: the raw values
+        are bounded by max(x)^(t-1), so small evaluation points (share
+        indices) need nowhere near 255 bits. Bucketing to a short ladder
+        keeps the compiled-variant count bounded."""
+        mx = max((int(x) for x in xs), default=1)
+        need = max(1, t - 1) * max(1, mx.bit_length()) + 1
+        for cand in (32, 64, 128):
+            if need <= cand:
+                return cand
+        return 255
+
+    def g1_gen_mul_batch(self, scalars: list[int]) -> list:
+        """[k_i] G over G1 via the fixed-base window table — the DKG
+        share/PoK verification LHS (public derived points; the scalar
+        inputs are shares the CALLER owns — they ride the device only as
+        packed limbs and come back as public curve points)."""
+        n = len(scalars)
+        if n == 0:
+            return []
+        pad = bucket_lanes(n)
+        s = C.fr_pack(self.fr_ctx, list(scalars) + [0] * (pad - n))
+        out = _g1_gen_mul_kernel(self.ctx, self.fr_ctx, 255, 4)(s)
+        return C.g1_unpack(self.ctx, out)[:n]
+
+    def commitment_eval_batch(self, commit_rows, xs: list[int], t: int) -> list:
+        """Evaluate commitment polynomials at public points, one lane per
+        row: row i is a flat tuple of vecs*t affine G1 commitments (vecs
+        concatenated degree-(t-1) vectors) and the result is
+        sum_vec sum_k C_k * xs[i]^k. THE ceremony-verification bulk."""
+        n = len(commit_rows)
+        if n == 0:
+            return []
+        width = len(commit_rows[0])
+        if width % t or any(len(r) != width for r in commit_rows):
+            raise ValueError("commitment rows must share one vecs*t width")
+        vecs = width // t
+        pad = bucket_lanes(n)
+        flat: list = []
+        for row in commit_rows:
+            flat.extend(row)
+        flat.extend([None] * ((pad - n) * width))
+        commits = C.g1_pack(self.ctx, flat)
+        commits = jax.tree_util.tree_map(
+            lambda a: a.reshape(pad, width, *a.shape[1:]), commits
+        )
+        xs_arr = jnp.asarray(
+            np.asarray(list(xs) + [0] * (pad - n), np.int32)
+        )
+        nbits = self._eval_nbits(t, xs)
+        out = _commitment_eval_kernel(self.ctx, self.fr_ctx, vecs, t, nbits)(
+            commits, xs_arr
+        )
+        return C.g1_unpack(self.ctx, out)[:n]
+
+    def g1_msm_batch(
+        self, points, scalars: list[int], segment_ids: list[int], n_segments: int
+    ) -> list:
+        """Segmented multi-scalar multiplication over G1 with full-width
+        scalars: out[s] = sum_{i: seg[i]==s} scalars[i] * points[i] — the
+        reshare pubshare recombination shape (Pippenger)."""
+        if n_segments <= 0:
+            return []
+        n = len(points)
+        seg_pad = _next_pow2(n_segments)
+        pad = bucket_lanes(max(n, 1))
+        pts = C.g1_pack(self.ctx, list(points) + [None] * (pad - n))
+        s = C.fr_pack(self.fr_ctx, list(scalars) + [0] * (pad - n))
+        seg = jnp.asarray(
+            np.asarray(list(segment_ids) + [0] * (pad - n), np.int32)
+        )
+        out = _g1_msm_kernel(self.ctx, self.fr_ctx, seg_pad, 255)(pts, s, seg)
+        return C.g1_unpack(self.ctx, out)[:n_segments]
+
+    def lagrange_coeffs_batch(
+        self, idx_rows, xs: list[int]
+    ) -> list[list[int]]:
+        """Lagrange basis rows at arbitrary evaluation points: row i is a
+        list of distinct share indices, xs[i] the evaluation point;
+        returns the matching coefficient rows as Python ints (public
+        values — functions of public indices only)."""
+        n = len(idx_rows)
+        if n == 0:
+            return []
+        t = len(idx_rows[0])
+        if any(len(r) != t for r in idx_rows):
+            raise ValueError("index rows must share one width")
+        pad = bucket_lanes(n)
+        benign = list(range(1, t + 1))
+        idx = np.asarray(
+            [list(r) for r in idx_rows] + [benign] * (pad - n), np.int32
+        )
+        xs_arr = jnp.asarray(
+            np.asarray(list(xs) + [0] * (pad - n), np.int32)
+        )
+        out = _lagrange_at_kernel(self.fr_ctx, t)(jnp.asarray(idx), xs_arr)
+        flat = limb.ctx_unpack(self.fr_ctx, np.asarray(out).reshape(pad * t, -1))
+        return [flat[i * t : (i + 1) * t] for i in range(n)]
 
 
 @functools.lru_cache(maxsize=None)

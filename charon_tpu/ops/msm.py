@@ -257,3 +257,44 @@ def msm_active() -> bool:
     if _MSM_MODE is not None:
         return _MSM_MODE
     return True
+
+
+# --- ceremony-path routing (DKG / resharing, ISSUE 20) ---------------------
+#
+# The ceremony kernels (blsops commitment_eval / g1_msm) have their own
+# routing flags, owned by core/autotune.KernelConfig exactly like the
+# duty-path set_msm above: commitment evaluation picks Straus joint
+# windowed mul vs per-lane double-and-add, and the reshare MSM picks its
+# Pippenger window width. Both are trace-time flags — flips require
+# blsops.clear_kernel_caches() (KernelConfig.apply does this).
+
+_CEREMONY_STRAUS: bool | None = None
+_CEREMONY_WINDOW: int | None = None
+
+
+def set_ceremony_straus(mode: bool | None) -> None:
+    """Commitment-polynomial evaluation: Straus joint windowed mul (True)
+    vs per-lane double-and-add (False); None restores the default (on)."""
+    global _CEREMONY_STRAUS
+    _CEREMONY_STRAUS = mode
+
+
+def ceremony_straus_active() -> bool:
+    if _CEREMONY_STRAUS is not None:
+        return _CEREMONY_STRAUS
+    return True
+
+
+def set_ceremony_window(window: int | None) -> None:
+    """Pippenger window width for the ceremony MSM (reshare pubshare
+    combination); None restores the default (8)."""
+    global _CEREMONY_WINDOW
+    if window is not None and not 1 <= window <= 16:
+        raise ValueError(f"ceremony MSM window out of range: {window}")
+    _CEREMONY_WINDOW = window
+
+
+def ceremony_window() -> int:
+    if _CEREMONY_WINDOW is not None:
+        return _CEREMONY_WINDOW
+    return 8

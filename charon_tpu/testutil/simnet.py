@@ -99,6 +99,39 @@ class SimCluster:
             if node.tracer is not None and node.tracer.jsonl_path
         ]
 
+    async def apply_reshare(
+        self,
+        new_share_keys: dict[int, dict[PubKey, bytes]],
+        new_pubshares_by_idx: dict[int, dict[PubKey, bytes]],
+    ) -> None:
+        """Rotate key material live, mid-duties (dkg/reshare output).
+
+        The per-node `share_keys` dict (held by each ValidatorMock) and
+        the shared `pubshares_by_idx` registry (held by every node's
+        Eth2Verifier and ValidatorAPI) are mutated IN PLACE, so the
+        rotation takes effect on the next signature without rebuilding
+        any node — the simnet mirror of app/run.Node.apply_reshare. A
+        node whose index is absent from `new_share_keys` (it left the
+        cluster) keeps its old share and its partials stop verifying
+        against the rotated registry. Nodes with a crypto plane re-warm
+        the point caches for the new pubshares (delta only)."""
+        for idx, shares in new_share_keys.items():
+            self.share_keys[idx - 1].clear()
+            self.share_keys[idx - 1].update(shares)
+        for idx, pubs in new_pubshares_by_idx.items():
+            self.pubshares_by_idx.setdefault(idx, {}).clear()
+            self.pubshares_by_idx[idx].update(pubs)
+        for node in self.nodes:
+            plane = node.crypto_plane
+            if plane is not None and hasattr(plane, "warm_caches"):
+                await plane.warm_caches(
+                    pubkeys=[
+                        p
+                        for pubs in new_pubshares_by_idx.values()
+                        for p in pubs.values()
+                    ]
+                )
+
     def dump_flight(self, out_dir: str) -> list[str]:
         """Dump every node's flight-recorder ring (flightrec=True
         builds) into out_dir; returns the per-node dump paths, ready

@@ -59,9 +59,13 @@ case "$TIER" in
     # persistent jit cache: the first-ever run pays the XLA:CPU
     # compiles, every later run replays them.
     python bench_autotune.py --smoke
+    # DKG ceremony gate (ISSUE 20): the batched verification wave must
+    # match the python host oracle lane-exactly; on an accelerator it
+    # must also beat the python g1_mul loop >= 5x (same-run A/B)
+    python bench_dkg.py --smoke
     # analysis gate (ISSUE 10): project-invariant linter + append-only
     # wire-schema + metrics-catalogue sync (seconds; jax-free)
-    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py
+    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py bench_dkg.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
     # flight-recorder event schema (ISSUE 19): append-only golden —
@@ -97,7 +101,7 @@ case "$TIER" in
     # (rule fixtures, sanitizer deadlock/leak scenarios, checker teeth,
     # seeded jaxpr violations) rides the fast tier in
     # tests/test_analysis_*.py.
-    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py
+    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py bench_dkg.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
     # flight-recorder event schema (ISSUE 19): append-only golden
@@ -131,13 +135,15 @@ case "$TIER" in
     python bench_hostplane.py --smoke --cold-start
     python bench_hostplane.py --tenants
     python bench_wire.py --smoke
-    # the autotune smoke (ISSUE 18) is the one hostplane gate that
-    # NEEDS jax (it really tunes + compiles); on jax-less images skip
-    # it LOUDLY — the jax-free gates above still ran
+    # the autotune smoke (ISSUE 18) and the DKG ceremony-wave gate
+    # (ISSUE 20) are the hostplane gates that NEED jax (they really
+    # tune + compile); on jax-less images skip them LOUDLY — the
+    # jax-free gates above still ran
     if python -c 'import jax' 2>/dev/null; then
-      exec python bench_autotune.py --smoke
+      python bench_autotune.py --smoke
+      exec python bench_dkg.py --smoke
     else
-      echo "WARNING: jax not importable — skipping autotune warm-boot gate" >&2
+      echo "WARNING: jax not importable — skipping autotune + dkg gates" >&2
       exit 0
     fi
     ;;
@@ -155,7 +161,8 @@ case "$TIER" in
     python bench_hostplane.py --smoke --cold-start
     python bench_wire.py --smoke
     python bench_autotune.py --smoke
-    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py
+    python bench_dkg.py --smoke
+    python -m charon_tpu.analysis.lint charon_tpu/ bench_wire.py bench_hostplane.py bench_autotune.py bench_dkg.py
     python -m charon_tpu.analysis.schema_check
     python -m charon_tpu.analysis.metrics_check
     python -m charon_tpu.analysis.flightrec_check

@@ -488,7 +488,12 @@ def test_repo_tree_lints_clean():
 
     root = pathlib.Path(lint.__file__).resolve().parents[2]
     targets = [str(root / "charon_tpu")]
-    for bench in ("bench_wire.py", "bench_hostplane.py"):
+    for bench in (
+        "bench_wire.py",
+        "bench_hostplane.py",
+        "bench_autotune.py",
+        "bench_dkg.py",
+    ):
         if (root / bench).exists():
             targets.append(str(root / bench))
     violations, n = lint.lint_paths(targets)
@@ -652,6 +657,64 @@ def test_secret_flow_out_of_scope_ignored():
         "def f(shares):\n    print(f'{shares}')\n", relpath="other/x.py"
     )
     assert not SecretFlow().applies(mod)
+
+
+def test_secret_flow_flags_leaked_reshare_poly_coeff():
+    # the ISSUE 20 regression shape: a reshare dealer's polynomial
+    # coefficients (constant term = its live share, rest fresh
+    # randomness) leaking through a debug log / error message — the
+    # exact tear the rule must catch in dkg/reshare.py
+    vs = run_sf(
+        """
+        import secrets
+        from charon_tpu.app import log
+        class Dealer:
+            def __init__(self, share, t_new):
+                self._poly = [share] + [
+                    secrets.randbelow(7) for _ in range(t_new - 1)
+                ]
+            def round1(self):
+                log.info("dealt", coeff0=self._poly[0])
+                raise ValueError(f"bad poly {self._poly}")
+        """,
+        relpath="charon_tpu/dkg/reshare_fixture.py",
+    )
+    assert names(vs) == ["secret-flow"] * 2
+    assert any("log call" in v.message for v in vs)
+    assert any("raised exception" in v.message for v in vs)
+
+
+def test_secret_flow_reshare_sub_share_via_transport():
+    # dealt sub-shares are secret until they reach the sealed
+    # per-receiver channel: a broadcast publish of the share tuple
+    # fires, the pragma'd audited send stays quiet
+    vs = run_sf(
+        """
+        import secrets
+        def deal(node, t):
+            subshares = [secrets.randbelow(7) for _ in range(t)]
+            node.publish("round1", subshares)
+        """,
+        relpath="charon_tpu/dkg/reshare_fixture.py",
+    )
+    assert names(vs) == ["secret-flow"]
+
+
+def test_secret_flow_reshare_and_frost_sweep_clean():
+    """The real ceremony modules carry tainted share/polynomial state
+    end to end and must still lint clean (repr=False dataclasses,
+    audited pragmas on the sealed sends)."""
+    import pathlib
+
+    root = pathlib.Path(lint.__file__).resolve().parents[2]
+    targets = [
+        str(root / "charon_tpu" / "dkg" / "reshare.py"),
+        str(root / "charon_tpu" / "dkg" / "frost.py"),
+        str(root / "charon_tpu" / "cmd" / "cli.py"),
+    ]
+    violations, n = lint.lint_paths(targets)
+    assert n == 3
+    assert violations == [], "\n".join(v.render() for v in violations)
 
 
 # -- pragma audit report (ISSUE 11) ------------------------------------------

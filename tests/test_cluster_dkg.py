@@ -120,6 +120,80 @@ def test_full_dkg_ceremony(tmp_path):
     tbls.verify(group_pk, msg, group_sig)
 
 
+def test_cli_reshare_roundtrip(tmp_path):
+    """DKG -> `reshare` CLI (proactive rotation, host path) -> the new
+    keystores still form the SAME group key and the old set is retired
+    to validator_keys.pre-reshare."""
+    from charon_tpu.cmd import cli
+
+    n, t, v = 3, 2, 2
+    defn, keys = make_definition(n, t, v)
+
+    async def run():
+        fnet = frost.MemFrostTransport(n)
+        xnet = MemExchangeNet(n)
+        return await asyncio.gather(
+            *(
+                run_dkg(
+                    defn,
+                    i,
+                    keys[i],
+                    fnet.participant(i + 1),
+                    xnet.port(i),
+                    data_dir=tmp_path / f"node{i}",
+                )
+                for i in range(n)
+            )
+        )
+
+    results = asyncio.run(run())
+    old_shares = [
+        keystore.load_keys(tmp_path / f"node{i}" / "validator_keys")
+        for i in range(n)
+    ]
+
+    # --threshold pins a pure rotation (the flag's default is the BFT
+    # formula for the new operator count, which would be 3-of-3 here)
+    rc = cli.main(
+        [
+            "reshare",
+            "--cluster-dir",
+            str(tmp_path),
+            "--threshold",
+            str(t),
+            "--no-tpu",
+        ]
+    )
+    assert rc == 0
+
+    # pubshare map for the lock/manifest update
+    out = json.loads((tmp_path / "reshare-pubshares.json").read_text())
+    assert out["num_operators"] == n
+    assert set(out["public_shares"]) == {"1", "2", "3"}
+
+    # every share rotated; pre-reshare sets retired alongside
+    for i in range(n):
+        ddir = tmp_path / f"node{i}"
+        assert keystore.load_keys(
+            ddir / "validator_keys.pre-reshare"
+        ) == old_shares[i]
+        assert keystore.load_keys(ddir / "validator_keys") != old_shares[i]
+
+    # a threshold of NEW shares still signs for the ORIGINAL group key
+    new_shares = {
+        i + 1: keystore.load_keys(tmp_path / f"node{i}" / "validator_keys")[0]
+        for i in range(t)
+    }
+    msg = b"post-reshare duty"
+    group_sig = tbls.threshold_aggregate(
+        {i: tbls.sign(s, msg) for i, s in new_shares.items()}
+    )
+    group_pk = bytes.fromhex(
+        results[0].lock.validators[0].distributed_public_key[2:]
+    )
+    tbls.verify(group_pk, msg, group_sig)
+
+
 def test_lock_verify_rejects_tampering():
     n, t, v = 3, 2, 1
     defn, keys = make_definition(n, t, v)

@@ -1,0 +1,315 @@
+"""Key resharing (dkg/reshare): operator join/leave, threshold change,
+proactive rotation — the group key never changes, every share does.
+
+Host-path protocol tests run the full lockstep ceremony over the
+in-memory transport; the device-engine equivalence test is marked slow
+(batched ceremony kernels pay an XLA:CPU compile on a cold cache).
+"""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.crypto import shamir
+from charon_tpu.crypto.fields import R
+from charon_tpu.crypto.g1g2 import G1_GEN, g1_mul
+from charon_tpu.dkg import reshare
+
+CTX = b"cluster-def-hash"
+
+
+def make_old_cluster(n=4, t=3, v=2, seed=1234):
+    """Shamir-split v group secrets over n old operators (deterministic
+    RNG so failures reproduce)."""
+    import random
+
+    rng = random.Random(seed)
+    secrets, shares_by_idx, old_pubshares, group_pks = [], {}, [], []
+    for _ in range(v):
+        secret = rng.randrange(1, R)
+        shares = shamir.split(secret, n, t, rand=lambda: rng.randrange(1, R))
+        secrets.append(secret)
+        for i, s in shares.items():
+            shares_by_idx.setdefault(i, []).append(s)
+        old_pubshares.append({i: g1_mul(G1_GEN, s) for i, s in shares.items()})
+        group_pks.append(g1_mul(G1_GEN, secret))
+    return secrets, shares_by_idx, old_pubshares, group_pks
+
+
+def run_ceremony(cfg, shares_by_idx, old_pubshares, group_pks,
+                 dealers=None, crash=(), engine=None, timeout=5.0):
+    dealers = tuple(dealers if dealers is not None else cfg.old_indices)
+    participants = sorted(set(dealers) | set(cfg.new_indices))
+    net = reshare.MemReshareTransport(dealers, timeout=timeout, crash=crash)
+
+    async def run():
+        return await asyncio.gather(
+            *(
+                reshare.run_reshare_parallel(
+                    net.participant(i),
+                    i,
+                    cfg,
+                    old_pubshares,
+                    group_pks,
+                    share_secrets=(
+                        shares_by_idx[i] if i in dealers else None
+                    ),
+                    engine=engine,
+                )
+                for i in participants
+            ),
+            return_exceptions=True,
+        )
+
+    return dict(zip(participants, asyncio.run(run())))
+
+
+def check_outputs(cfg, results, secrets, group_pks):
+    """The resharing invariants: same group key, consistent pubshare
+    maps, any t_new new shares recover the ORIGINAL secret."""
+    v = cfg.num_validators
+    receivers = [j for j in cfg.new_indices]
+    for val in range(v):
+        ref = results[receivers[0]][val]
+        assert ref.group_pubkey == group_pks[val]
+        for j in receivers[1:]:
+            r = results[j][val]
+            assert r.group_pubkey == group_pks[val]
+            assert r.pubshares == ref.pubshares
+        # each receiver's share matches its advertised pubshare
+        for j in receivers:
+            r = results[j][val]
+            assert g1_mul(G1_GEN, r.secret_share) == r.pubshares[j]
+        # any t_new of the new shares recover the original secret
+        subset = receivers[: cfg.t_new]
+        rec = shamir.recover_secret(
+            {j: results[j][val].secret_share for j in subset}
+        )
+        assert rec == secrets[val]
+
+
+def test_reshare_threshold_change_4of7_to_5of9():
+    secrets, shares, old_pubs, gpks = make_old_cluster(n=7, t=4, v=2)
+    cfg = reshare.ReshareConfig(
+        old_indices=tuple(range(1, 8)),
+        new_indices=tuple(range(1, 10)),
+        t_old=4,
+        t_new=5,
+        num_validators=2,
+        ctx=CTX,
+    )
+    results = run_ceremony(cfg, shares, old_pubs, gpks)
+    check_outputs(cfg, results, secrets, gpks)
+
+
+def test_reshare_join_and_leave():
+    # operator 1 leaves, 5 and 6 join; only a t_old quorum deals
+    secrets, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(2, 3, 4),
+        new_indices=(2, 3, 4, 5, 6),
+        t_old=3,
+        t_new=4,
+        num_validators=1,
+        ctx=CTX,
+    )
+    results = run_ceremony(cfg, shares, old_pubs, gpks)
+    check_outputs(cfg, results, secrets, gpks)
+    # the leaving node's old share is NOT a valid share of the new
+    # polynomial: interpolating it with t_new - 1 new shares misses
+    old_share_1 = shares[1][0]
+    pts = {1: old_share_1}
+    for j in (2, 3, 4):
+        pts[j] = results[j][0].secret_share
+    assert shamir.recover_secret(pts) != secrets[0]
+
+
+def test_reshare_proactive_rotation_changes_every_share():
+    secrets, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=1,
+        ctx=CTX,
+    )
+    results = run_ceremony(cfg, shares, old_pubs, gpks)
+    check_outputs(cfg, results, secrets, gpks)
+    for j in (1, 2, 3, 4):
+        assert results[j][0].secret_share != shares[j][0]
+        # pubshares rotated too — the registry the verifier swaps in
+        assert results[j][0].pubshares[j] != old_pubs[0][j]
+
+
+def test_reshare_repr_never_leaks_shares():
+    # secret-flow regression: formatting ceremony objects must not
+    # print share scalars (repr=False fields)
+    secrets, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=1,
+    )
+    results = run_ceremony(cfg, shares, old_pubs, gpks)
+    dealer = reshare.ReshareDealer(1, cfg, shares[1])
+    _, dealt = dealer.round1()
+    for obj in (results[1][0], dealt[2]):
+        text = repr(obj)
+        for blob in (results[1][0].secret_share, dealt[2].shares[0]):
+            assert str(blob) not in text
+
+
+def test_reshare_rejects_tampered_subshare():
+    _, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=1,
+    )
+    dealers = {
+        i: reshare.ReshareDealer(i, cfg, shares[i]) for i in cfg.old_indices
+    }
+    bcasts, dealt = {}, {}
+    for i, d in dealers.items():
+        b, s = d.round1()
+        bcasts[i] = b
+        dealt[i] = s
+    my = {i: dealt[i][2] for i in dealers}
+    my[3] = reshare.ReshareShares(
+        shares=tuple((s + 1) % R for s in my[3].shares)
+    )
+    with pytest.raises(reshare.ReshareError, match="sub-share"):
+        reshare.ReshareReceiver(2, cfg).round2(bcasts, my, old_pubs, gpks)
+
+
+def test_reshare_rejects_unbound_commitment():
+    # a dealer whose constant term is NOT its live pubshare could
+    # change the group key — the binding check must catch it
+    _, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=1,
+    )
+    rogue_shares = [shares[1][0] + 1]
+    dealers = {
+        i: reshare.ReshareDealer(
+            i, cfg, rogue_shares if i == 1 else shares[i]
+        )
+        for i in cfg.old_indices
+    }
+    bcasts, my = {}, {}
+    for i, d in dealers.items():
+        b, s = d.round1()
+        bcasts[i] = b
+        my[i] = s[2]
+    with pytest.raises(reshare.ReshareError, match="bind"):
+        reshare.ReshareReceiver(2, cfg).round2(bcasts, my, old_pubs, gpks)
+
+
+def test_reshare_requires_dealer_quorum():
+    _, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=1,
+    )
+    results = run_ceremony(
+        cfg, shares, old_pubs, gpks, dealers=(1, 2), timeout=1.0
+    )
+    for j in cfg.new_indices:
+        assert isinstance(results[j], reshare.ReshareError)
+
+
+def test_reshare_dealer_crash_aborts_everyone():
+    _, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=1)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=1,
+    )
+    results = run_ceremony(
+        cfg, shares, old_pubs, gpks, crash=(3,), timeout=1.0
+    )
+    for j in results:
+        assert isinstance(results[j], reshare.ReshareError)
+
+
+def test_reshare_config_validation():
+    with pytest.raises(reshare.ReshareError):
+        reshare.ReshareConfig((1, 1, 2), (1, 2, 3), 2, 2, 1)
+    with pytest.raises(reshare.ReshareError):
+        reshare.ReshareConfig((0, 1, 2), (1, 2, 3), 2, 2, 1)
+    with pytest.raises(reshare.ReshareError):
+        reshare.ReshareConfig((1, 2, 3), (1, 2, 3), 4, 2, 1)
+    with pytest.raises(reshare.ReshareError):
+        reshare.ReshareConfig((1, 2, 3), (1, 2), 2, 3, 1)
+    with pytest.raises(reshare.ReshareError):
+        reshare.ReshareConfig((1, 2, 3), (1, 2, 3), 2, 2, 0)
+
+
+def test_write_reshare_outputs_atomic_swap(tmp_path):
+    pytest.importorskip(
+        "cryptography",
+        reason="EIP-2335 keystores need the optional 'cryptography' package",
+    )
+    from charon_tpu.eth2util import keystore
+
+    secrets, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=2)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4),
+        t_old=3,
+        t_new=3,
+        num_validators=2,
+    )
+    results = run_ceremony(cfg, shares, old_pubs, gpks)
+
+    data_dir = tmp_path / "node0"
+    # seed a pre-reshare key dir so the swap has something to retire
+    old_secrets = [
+        (s % (1 << 256)).to_bytes(32, "big") for s in shares[1]
+    ]
+    keystore.store_keys(  # test fixture  # lint: allow(secret-flow)
+        old_secrets, data_dir / "validator_keys"
+    )
+    stale = reshare.write_reshare_outputs(data_dir, results[1])
+    assert stale == data_dir / "validator_keys.pre-reshare"
+    assert keystore.load_keys(stale) == old_secrets
+    loaded = keystore.load_keys(data_dir / "validator_keys")
+    assert [int.from_bytes(b, "big") for b in loaded] == [
+        r.secret_share for r in results[1]
+    ]
+    # no torn staging dirs left behind
+    assert not [p for p in data_dir.iterdir() if "stage" in p.name]
+
+
+@pytest.mark.slow
+def test_reshare_device_engine_matches_host():
+    from charon_tpu.ops.blsops import BlsEngine
+
+    secrets, shares, old_pubs, gpks = make_old_cluster(n=4, t=3, v=2)
+    cfg = reshare.ReshareConfig(
+        old_indices=(1, 2, 3, 4),
+        new_indices=(1, 2, 3, 4, 5),
+        t_old=3,
+        t_new=3,
+        num_validators=2,
+        ctx=CTX,
+    )
+    # every invariant (binding, sub-share validity, pubshare
+    # consistency, group-key preservation, secret recovery) holds with
+    # the batched device kernels doing the verification waves
+    dev = run_ceremony(cfg, shares, old_pubs, gpks, engine=BlsEngine())
+    check_outputs(cfg, dev, secrets, gpks)
